@@ -1,0 +1,19 @@
+"""Test configuration: run on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy tier 2 (SURVEY.md §4):
+LocalQueryRunner-style in-process tests, multi-"node" via
+xla_force_host_platform_device_count instead of real chips.
+"""
+
+import os
+
+# Force CPU for unit tests even when launched from a TPU-attached shell;
+# set TRINO_TPU_TEST_PLATFORM to override (e.g. to run the suite on chip).
+os.environ["JAX_PLATFORMS"] = os.environ.get(
+    "TRINO_TPU_TEST_PLATFORM", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import trino_tpu  # noqa: E402,F401  (enables x64)

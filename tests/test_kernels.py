@@ -1,0 +1,230 @@
+"""Unit tests for the M0 kernel substrate (SURVEY.md §7 build order).
+
+Modeled on the reference's operator unit tests
+(core/trino-main/src/test/java/io/trino/operator/TestHashAggregationOperator
+etc.), but asserting against plain-python recomputation.
+"""
+
+import numpy as np
+import pytest
+
+from trino_tpu.columnar import Batch, batch_from_pylist, concat_batches
+from trino_tpu.ops.compact import filter_batch, limit_batch, offset_batch
+from trino_tpu.ops.groupby import (AggInput, global_aggregate,
+                                   group_aggregate)
+from trino_tpu.ops.join import (cross_counts, expand_join, match_counts,
+                                semi_join_mask)
+from trino_tpu.ops.sort import SortKey, sort_batch, topn_batch
+from trino_tpu.types import BIGINT, DOUBLE, INTEGER, VARCHAR, DecimalType
+
+import jax.numpy as jnp
+
+
+def make_batch():
+    return batch_from_pylist(
+        {
+            "k": [1, 2, 1, 3, 2, 1, None, 3],
+            "v": [10.0, 20.0, 30.0, None, 50.0, 60.0, 70.0, 80.0],
+            "s": ["a", "b", "a", "c", None, "b", "a", "c"],
+        },
+        {"k": BIGINT, "v": DOUBLE, "s": VARCHAR},
+    )
+
+
+def test_pylist_roundtrip():
+    b = make_batch()
+    rows = b.to_pylist()
+    assert rows[0] == [1, 10.0, "a"]
+    assert rows[6] == [None, 70.0, "a"]
+    assert len(rows) == 8
+
+
+def test_filter_compacts():
+    b = make_batch()
+    k = jnp.asarray(b.column("k").data)
+    kv = b.column("k").valid_mask()
+    out = filter_batch(b, (k == 1) & kv)
+    rows = out.to_pylist()
+    assert rows == [[1, 10.0, "a"], [1, 30.0, "a"], [1, 60.0, "b"]]
+
+
+def test_limit_offset():
+    b = make_batch()
+    assert len(limit_batch(b, 3).to_pylist()) == 3
+    rows = offset_batch(b, 6).to_pylist()
+    assert len(rows) == 2
+    assert rows[0][1] == 70.0
+
+
+def test_group_aggregate_sum_count_min_max():
+    b = make_batch()
+    out = group_aggregate(
+        b, ["k"],
+        [AggInput("sum", "v", output="sv"),
+         AggInput("count", "v", output="cv"),
+         AggInput("count_star", output="cs"),
+         AggInput("min", "v", output="mn"),
+         AggInput("max", "v", output="mx")])
+    rows = {r[0]: r[1:] for r in out.to_pylist()}
+    assert len(rows) == 4  # 1, 2, 3, NULL
+    assert rows[1] == [100.0, 3, 3, 10.0, 60.0]
+    assert rows[2] == [70.0, 2, 2, 20.0, 50.0]
+    assert rows[3] == [80.0, 1, 2, 80.0, 80.0]  # one NULL v in group 3
+    assert rows[None] == [70.0, 1, 1, 70.0, 70.0]
+
+
+def test_group_by_string_key():
+    b = make_batch()
+    out = group_aggregate(b, ["s"], [AggInput("count_star", output="c")])
+    rows = {r[0]: r[1] for r in out.to_pylist()}
+    assert rows == {"a": 3, "b": 2, "c": 2, None: 1}
+
+
+def test_group_by_multi_key():
+    b = make_batch()
+    out = group_aggregate(b, ["k", "s"],
+                          [AggInput("count_star", output="c")])
+    rows = {(r[0], r[1]): r[2] for r in out.to_pylist()}
+    assert rows[(1, "a")] == 2
+    assert rows[(1, "b")] == 1
+    assert rows[(None, "a")] == 1
+
+
+def test_global_aggregate():
+    b = make_batch()
+    out = global_aggregate(
+        b, [AggInput("sum", "v", output="s"),
+            AggInput("count", "k", output="c"),
+            AggInput("count_star", output="cs"),
+            AggInput("min", "v", output="mn")])
+    assert out.to_pylist() == [[320.0, 7, 8, 10.0]]
+
+
+def test_global_aggregate_empty():
+    b = batch_from_pylist({"v": []}, {"v": DOUBLE})
+    out = global_aggregate(b, [AggInput("sum", "v", output="s"),
+                               AggInput("count", "v", output="c")])
+    assert out.to_pylist() == [[None, 0]]
+
+
+def test_sort_and_nulls():
+    b = make_batch()
+    out = sort_batch(b, [SortKey("v", ascending=False)])
+    vals = [r[1] for r in out.to_pylist()]
+    assert vals == [None, 80.0, 70.0, 60.0, 50.0, 30.0, 20.0, 10.0]
+    out2 = sort_batch(b, [SortKey("v", ascending=True)])
+    vals2 = [r[1] for r in out2.to_pylist()]
+    assert vals2 == [10.0, 20.0, 30.0, 50.0, 60.0, 70.0, 80.0, None]
+
+
+def test_sort_string_and_multikey():
+    b = make_batch()
+    out = sort_batch(b, [SortKey("s"), SortKey("v", ascending=False)])
+    rows = out.to_pylist()
+    assert [r[2] for r in rows[:3]] == ["a", "a", "a"]
+    assert [r[1] for r in rows[:3]] == [70.0, 30.0, 10.0]
+    assert rows[-1][2] is None  # nulls last
+
+
+def test_topn():
+    b = make_batch()
+    out = topn_batch(b, [SortKey("v", ascending=False,
+                                 nulls_first=False)], 2)
+    assert [r[1] for r in out.to_pylist()] == [80.0, 70.0]
+
+
+def _join(probe, build, pk, bk, join_type="inner", prefix="b_"):
+    start, count, order = match_counts(probe, build, pk, bk)
+    total = int(jnp.maximum(count, 1).sum()) if join_type == "left" \
+        else int(count.sum())
+    cap = max(8, 1 << max(0, (total - 1).bit_length()))
+    return expand_join(probe, build, start, count, order, cap,
+                       join_type, prefix)
+
+
+def test_inner_join():
+    probe = batch_from_pylist({"k": [1, 2, 3, None, 5]},
+                              {"k": BIGINT})
+    build = batch_from_pylist({"k": [1, 1, 2, None], "w": [7, 8, 9, 10]},
+                              {"k": BIGINT, "w": BIGINT})
+    out = _join(probe, build, ["k"], ["k"])
+    rows = sorted(map(tuple, out.to_pylist()))
+    assert rows == [(1, 1, 7), (1, 1, 8), (2, 2, 9)]
+
+
+def test_left_join():
+    probe = batch_from_pylist({"k": [1, 3, None]}, {"k": BIGINT})
+    build = batch_from_pylist({"k": [1, 2], "w": [7, 9]},
+                              {"k": BIGINT, "w": BIGINT})
+    out = _join(probe, build, ["k"], ["k"], "left")
+    rows = sorted(map(tuple, out.to_pylist()),
+                  key=lambda r: (r[0] is None, r))
+    assert rows == [(1, 1, 7), (3, None, None), (None, None, None)]
+
+
+def test_multikey_join():
+    probe = batch_from_pylist({"a": [1, 1, 2], "b": [10, 11, 10]},
+                              {"a": BIGINT, "b": BIGINT})
+    build = batch_from_pylist({"a": [1, 2], "b": [10, 10],
+                               "w": [100, 200]},
+                              {"a": BIGINT, "b": BIGINT, "w": BIGINT})
+    out = _join(probe, build, ["a", "b"], ["a", "b"])
+    rows = sorted(map(tuple, out.to_pylist()))
+    assert rows == [(1, 10, 1, 10, 100), (2, 10, 2, 10, 200)]
+
+
+def test_semi_join_mask():
+    probe = batch_from_pylist({"k": [1, 2, None]}, {"k": BIGINT})
+    build = batch_from_pylist({"k": [1, None]}, {"k": BIGINT})
+    matched, key_null, has_null, nonempty = semi_join_mask(
+        probe, build, ["k"], ["k"])
+    assert list(np.asarray(matched)[:3]) == [True, False, False]
+    assert list(np.asarray(key_null)[:3]) == [False, False, True]
+    assert bool(has_null) and bool(nonempty)
+
+
+def test_cross_join():
+    probe = batch_from_pylist({"a": [1, 2]}, {"a": BIGINT})
+    build = batch_from_pylist({"b": [10, 20, 30]}, {"b": BIGINT})
+    start, count, order = cross_counts(probe, build)
+    out = expand_join(probe, build, start, count, order, 8, "inner", "")
+    rows = sorted(map(tuple, out.to_pylist()))
+    assert len(rows) == 6
+    assert rows[0] == (1, 10)
+
+
+def test_concat_batches_merges_dictionaries():
+    b1 = batch_from_pylist({"s": ["x", "y"]}, {"s": VARCHAR})
+    b2 = batch_from_pylist({"s": ["y", "z"]}, {"s": VARCHAR})
+    out = concat_batches([b1, b2])
+    assert [r[0] for r in out.to_pylist()] == ["x", "y", "y", "z"]
+
+
+def test_decimal_column():
+    b = batch_from_pylist({"d": [1.25, 2.50, None]},
+                          {"d": DecimalType(10, 2)})
+    assert b.to_pylist() == [[1.25], [2.5], [None]]
+
+
+def test_decimal_half_up_rounding():
+    # 1.115 * 100 == 111.4999... in binary floats; must store 112
+    b = batch_from_pylist({"d": [1.115]}, {"d": DecimalType(10, 2)})
+    assert b.to_pylist() == [[1.12]]
+
+
+def test_string_join_across_dictionaries():
+    probe = batch_from_pylist({"s": ["a", "b"]}, {"s": VARCHAR})
+    build = batch_from_pylist({"s": ["b", "c"], "w": [1, 2]},
+                              {"s": VARCHAR, "w": BIGINT})
+    out = _join(probe, build, ["s"], ["s"], prefix="b_")
+    assert out.to_pylist() == [["b", "b", 1]]
+
+
+def test_string_min_max_uses_collation():
+    b = batch_from_pylist({"g": [1, 1], "s": ["b", "a"]},
+                          {"g": BIGINT, "s": VARCHAR})
+    out = group_aggregate(b, ["g"], [AggInput("min", "s", output="mn"),
+                                     AggInput("max", "s", output="mx")])
+    assert out.to_pylist() == [[1, "a", "b"]]
+    gout = global_aggregate(b, [AggInput("min", "s", output="mn")])
+    assert gout.to_pylist() == [["a"]]

@@ -1,0 +1,15 @@
+"""trino_tpu — a TPU-native distributed SQL query engine.
+
+A ground-up reimplementation of the capabilities of Trino (reference
+surveyed in SURVEY.md) designed for TPUs: columnar batches are HBM-resident
+jax.Arrays, operator pipelines compile to fused XLA programs via jax.jit,
+and the shuffle/exchange layer lowers to XLA collectives over ICI.
+"""
+
+from . import config  # noqa: F401  — enables x64; must be first
+from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, SMALLINT,
+                    TINYINT, UNKNOWN, VARCHAR, DecimalType, Type,
+                    VarcharType, parse_type)
+from .columnar import Batch, Column, StringDictionary, batch_from_pylist
+
+__version__ = "0.1.0"

@@ -1,0 +1,411 @@
+"""Columnar data model: Column / Batch — the TPU-native Page/Block.
+
+Reference parity: core/trino-spi/src/main/java/io/trino/spi/Page.java:33-358
+and spi/block/* (70 files). Redesigned for XLA rather than translated:
+
+- A ``Column`` is a struct-of-arrays: a dense device value lane (``data``),
+  an optional validity lane (``valid``; None means all-valid — the analog of
+  Block.mayHaveNull()==false), and for string types a host-side deduplicated
+  ``dictionary`` (DictionaryBlock made primary, SURVEY.md §7.1).
+- A ``Batch`` is a named tuple of Columns plus a row count. Physical array
+  length ("capacity") is a power-of-two bucket >= the logical ``num_rows``;
+  rows past num_rows are garbage and every kernel masks them with
+  ``iota < num_rows``. This is how data-dependent cardinalities (filters,
+  joins) keep static shapes for XLA without a recompile per row-count.
+- LazyBlock's deferred-load role (spi/block/LazyBlock.java) is played by
+  host-resident numpy until a kernel first touches a column, at which point
+  jnp.asarray uploads it to HBM.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+from typing import Dict, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import config  # noqa: F401  (enables x64 before any jnp use)
+from .config import capacity_for
+from .types import (BOOLEAN, DOUBLE, BIGINT, DecimalType, Type, VarcharType,
+                    CharType, is_string)
+
+ArrayLike = Union[jax.Array, np.ndarray]
+
+
+class StringDictionary:
+    """Host-side deduplicated string pool backing a dictionary column.
+
+    Codes are int32 indices into ``values``. The dictionary is immutable;
+    merges produce a new dictionary plus a remap array usable as a device
+    gather (reference analog: DictionaryBlock id remapping,
+    spi/block/DictionaryBlock.java).
+    """
+
+    __slots__ = ("values", "_index")
+
+    def __init__(self, values: np.ndarray, _index: Optional[dict] = None):
+        self.values = np.asarray(values, dtype=object)
+        self._index = _index
+
+    @staticmethod
+    def from_strings(strings: Sequence[Optional[str]]):
+        """Build (dictionary, codes) from raw strings; None -> code 0."""
+        uniq: Dict[str, int] = {}
+        codes = np.empty(len(strings), dtype=np.int32)
+        for i, s in enumerate(strings):
+            if s is None:
+                codes[i] = 0
+                continue
+            c = uniq.get(s)
+            if c is None:
+                c = uniq.setdefault(s, len(uniq))
+            codes[i] = c
+        if not uniq:
+            uniq[""] = 0
+        vals = np.empty(len(uniq), dtype=object)
+        for s, c in uniq.items():
+            vals[c] = s
+        return StringDictionary(vals, uniq), codes
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+    @property
+    def index(self) -> dict:
+        if self._index is None:
+            self._index = {s: i for i, s in enumerate(self.values)}
+        return self._index
+
+    def code_of(self, s: str) -> int:
+        """Code for s, or -1 if absent (no row can equal it)."""
+        return self.index.get(s, -1)
+
+    def rank_codes(self) -> np.ndarray:
+        """rank[code] = collation rank of values[code]; for ORDER BY."""
+        order = np.argsort(self.values.astype(str), kind="stable")
+        ranks = np.empty(len(self.values), dtype=np.int32)
+        ranks[order] = np.arange(len(self.values), dtype=np.int32)
+        return ranks
+
+    def merge(self, other: "StringDictionary"):
+        """Unify with other; returns (merged, remap_self, remap_other)."""
+        if other is self:
+            n = len(self.values)
+            ident = np.arange(n, dtype=np.int32)
+            return self, ident, ident
+        idx = dict(self.index)
+        vals: List[str] = list(self.values)
+        remap_other = np.empty(len(other.values), dtype=np.int32)
+        for i, s in enumerate(other.values):
+            c = idx.get(s)
+            if c is None:
+                c = len(vals)
+                idx[s] = c
+                vals.append(s)
+        for i, s in enumerate(other.values):
+            remap_other[i] = idx[s]
+        merged = StringDictionary(np.asarray(vals, dtype=object), idx)
+        remap_self = np.arange(len(self.values), dtype=np.int32)
+        return merged, remap_self, remap_other
+
+
+@dataclass(frozen=True)
+class Column:
+    """One SQL column: value lane + validity lane (+ dictionary, + hi lane).
+
+    ``data`` rows beyond the owning Batch's num_rows are garbage.
+    ``valid`` is None when every (live) row is non-null.
+    ``data2`` is the high int64 lane for DECIMAL(p>18) Int128 emulation.
+    """
+
+    type: Type
+    data: ArrayLike
+    valid: Optional[ArrayLike] = None
+    dictionary: Optional[StringDictionary] = None
+    data2: Optional[ArrayLike] = None
+
+    def __post_init__(self):
+        if is_string(self.type) and self.dictionary is None:
+            raise ValueError(f"string column of type {self.type} needs a "
+                             "dictionary")
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[0])
+
+    def on_device(self) -> "Column":
+        d = jnp.asarray(self.data)
+        v = None if self.valid is None else jnp.asarray(self.valid)
+        d2 = None if self.data2 is None else jnp.asarray(self.data2)
+        return replace(self, data=d, valid=v, data2=d2)
+
+    def gather(self, indices: ArrayLike, fill_invalid: Optional[ArrayLike]
+               = None) -> "Column":
+        """Row gather; optionally mark gathered rows invalid where
+        ``fill_invalid`` is True (used for outer-join null padding)."""
+        data = jnp.take(jnp.asarray(self.data), indices, axis=0,
+                        mode="clip")
+        valid = (None if self.valid is None
+                 else jnp.take(jnp.asarray(self.valid), indices, axis=0,
+                               mode="clip"))
+        if fill_invalid is not None:
+            base = jnp.ones_like(indices, dtype=bool) if valid is None \
+                else valid
+            valid = base & ~fill_invalid
+        data2 = (None if self.data2 is None
+                 else jnp.take(jnp.asarray(self.data2), indices, axis=0,
+                               mode="clip"))
+        return replace(self, data=data, valid=valid, data2=data2)
+
+    def valid_mask(self, n: Optional[int] = None) -> jax.Array:
+        cap = self.capacity if n is None else n
+        if self.valid is None:
+            return jnp.ones((cap,), dtype=bool)
+        return jnp.asarray(self.valid)[:cap]
+
+    def with_dictionary(self, dictionary: StringDictionary,
+                        remap: np.ndarray) -> "Column":
+        """Rewrite codes through remap into a merged dictionary."""
+        codes = jnp.take(jnp.asarray(remap), jnp.asarray(self.data),
+                         axis=0, mode="clip")
+        return replace(self, data=codes, dictionary=dictionary)
+
+
+def _to_lane(values, typ: Type):
+    """numpy-ify a python sequence for a non-string column; returns
+    (data, valid|None)."""
+    dt = typ.np_dtype
+    n = len(values)
+    data = np.zeros(n, dtype=dt)
+    valid = np.ones(n, dtype=bool)
+    any_null = False
+    for i, v in enumerate(values):
+        if v is None:
+            valid[i] = False
+            any_null = True
+        elif isinstance(typ, DecimalType):
+            if isinstance(v, int):
+                data[i] = v * (10 ** typ.scale)
+            else:
+                # exact decimal scaling with HALF_UP (Trino rounding,
+                # reference: spi/type/Decimals.java) — going through
+                # binary float multiply would be off-by-one near .5
+                import decimal
+                q = decimal.Decimal(str(v)).scaleb(typ.scale).to_integral_value(
+                    rounding=decimal.ROUND_HALF_UP)
+                data[i] = int(q)
+        elif typ is BOOLEAN or typ.name == "boolean":
+            data[i] = bool(v)
+        else:
+            data[i] = v
+    return data, (valid if any_null else None)
+
+
+def column_from_pylist(values: Sequence, typ: Type) -> Column:
+    """Build a host Column from python values (tests / VALUES literals)."""
+    if is_string(typ):
+        dictionary, codes = StringDictionary.from_strings(
+            [v for v in values])
+        valid = np.asarray([v is not None for v in values], dtype=bool)
+        return Column(typ, codes,
+                      None if valid.all() else valid, dictionary)
+    data, valid = _to_lane(values, typ)
+    return Column(typ, data, valid)
+
+
+def column_from_numpy(arr: np.ndarray, typ: Type,
+                      valid: Optional[np.ndarray] = None) -> Column:
+    return Column(typ, np.asarray(arr, dtype=typ.np_dtype), valid)
+
+
+@dataclass(frozen=True)
+class Batch:
+    """A batch of rows: ordered named Columns + row count.
+
+    ``num_rows`` may be a python int (host-known) or a 0-d device int64
+    (data-dependent, e.g. post-filter). Kernels use ``num_rows_device``;
+    host logic calls ``num_rows_host`` (blocks on the device value).
+    """
+
+    columns: Dict[str, Column]
+    num_rows: Union[int, jax.Array]
+
+    @property
+    def names(self) -> List[str]:
+        return list(self.columns.keys())
+
+    @property
+    def capacity(self) -> int:
+        for c in self.columns.values():
+            return c.capacity
+        return 0
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    def num_rows_device(self) -> jax.Array:
+        return jnp.asarray(self.num_rows, dtype=jnp.int64)
+
+    def num_rows_host(self) -> int:
+        n = self.num_rows
+        return int(n) if not isinstance(n, int) else n
+
+    def row_valid(self) -> jax.Array:
+        """iota < num_rows over the capacity."""
+        return (jnp.arange(self.capacity, dtype=jnp.int64)
+                < self.num_rows_device())
+
+    def on_device(self) -> "Batch":
+        return Batch({k: c.on_device() for k, c in self.columns.items()},
+                     self.num_rows)
+
+    def select_columns(self, names: Sequence[str]) -> "Batch":
+        return Batch({n: self.columns[n] for n in names}, self.num_rows)
+
+    def rename(self, mapping: Dict[str, str]) -> "Batch":
+        return Batch({mapping.get(k, k): c
+                      for k, c in self.columns.items()}, self.num_rows)
+
+    def gather(self, indices: ArrayLike,
+               num_rows: Union[int, jax.Array]) -> "Batch":
+        return Batch({k: c.gather(indices)
+                      for k, c in self.columns.items()}, num_rows)
+
+    # --- host materialization (result delivery / tests) ------------------
+    def to_pylist(self) -> List[list]:
+        """Rows as python lists (client result encoding, reference:
+        server/protocol/QueryResultRows.java)."""
+        n = self.num_rows_host()
+        out_cols = []
+        for c in self.columns.values():
+            data = np.asarray(c.data)[:n]
+            valid = (np.ones(n, dtype=bool) if c.valid is None
+                     else np.asarray(c.valid)[:n])
+            t = c.type
+            col: List = []
+            if is_string(t):
+                vals = c.dictionary.values
+                for i in range(n):
+                    col.append(str(vals[int(data[i])]) if valid[i] else None)
+                    if (col[-1] is not None and isinstance(t, CharType)):
+                        col[-1] = col[-1].ljust(t.length)
+            elif isinstance(t, DecimalType):
+                s = t.scale
+                for i in range(n):
+                    if not valid[i]:
+                        col.append(None)
+                    else:
+                        if c.data2 is not None:
+                            # (hi, lo) two's-complement Int128: lo is the
+                            # unsigned low 64 bits, hi carries the sign
+                            lo = int(data[i]) & ((1 << 64) - 1)
+                            q = (int(np.asarray(c.data2)[i]) << 64) + lo
+                        else:
+                            q = int(data[i])
+                        col.append(q / (10 ** s) if s else q)
+            elif t.name == "boolean":
+                col = [bool(data[i]) if valid[i] else None for i in range(n)]
+            elif t.name in ("real", "double"):
+                col = [float(data[i]) if valid[i] else None
+                       for i in range(n)]
+            else:
+                col = [int(data[i]) if valid[i] else None for i in range(n)]
+            out_cols.append(col)
+        return [list(row) for row in zip(*out_cols)] if out_cols else []
+
+    def schema(self) -> Dict[str, Type]:
+        return {k: c.type for k, c in self.columns.items()}
+
+
+def batch_from_pylist(data: Dict[str, Sequence], schema: Dict[str, Type],
+                      pad_to_bucket: bool = True) -> Batch:
+    cols = {}
+    n = 0
+    for name, typ in schema.items():
+        col = column_from_pylist(data[name], typ)
+        n = len(data[name])
+        cols[name] = col
+    if pad_to_bucket:
+        # pad even empty batches: capacity-0 arrays break jnp.take
+        cap = capacity_for(n, minimum=8)
+        cols = {k: _pad(c, cap) for k, c in cols.items()}
+    return Batch(cols, n)
+
+
+def _pad(col: Column, cap: int) -> Column:
+    n = col.data.shape[0]
+    if n >= cap:
+        return col
+    pad = cap - n
+    data = np.concatenate(
+        [np.asarray(col.data),
+         np.zeros(pad, dtype=np.asarray(col.data).dtype)])
+    valid = None if col.valid is None else np.concatenate(
+        [np.asarray(col.valid), np.zeros(pad, dtype=bool)])
+    data2 = None if col.data2 is None else np.concatenate(
+        [np.asarray(col.data2),
+         np.zeros(pad, dtype=np.asarray(col.data2).dtype)])
+    return replace(col, data=data, valid=valid, data2=data2)
+
+
+def pad_batch(batch: Batch, cap: int) -> Batch:
+    return Batch({k: _pad(c, cap) for k, c in batch.columns.items()},
+                 batch.num_rows)
+
+
+def empty_batch(schema: Dict[str, Type], capacity: int = 8) -> Batch:
+    cols = {}
+    for name, typ in schema.items():
+        if is_string(typ):
+            d, _ = StringDictionary.from_strings([])
+            cols[name] = Column(typ, np.zeros(capacity, dtype=np.int32),
+                                None, d)
+        else:
+            cols[name] = Column(
+                typ, np.zeros(capacity, dtype=typ.np_dtype), None)
+    return Batch(cols, 0)
+
+
+def concat_batches(batches: Sequence[Batch]) -> Batch:
+    """Host-side concatenation of result batches (final GATHER stage)."""
+    batches = [b for b in batches if b.num_rows_host() > 0] or batches[:1]
+    if len(batches) == 1:
+        return batches[0]
+    names = batches[0].names
+    total = sum(b.num_rows_host() for b in batches)
+    cols: Dict[str, Column] = {}
+    for name in names:
+        parts = [b.column(name) for b in batches]
+        typ = parts[0].type
+        datas, valids = [], []
+        if is_string(typ):
+            merged = parts[0].dictionary
+            remaps = [np.arange(len(merged), dtype=np.int32)]
+            for p in parts[1:]:
+                merged, rs, ro = merged.merge(p.dictionary)
+                remaps = [r for r in remaps]
+                remaps.append(ro)
+            for p, rm, b in zip(parts, remaps, batches):
+                n = b.num_rows_host()
+                codes = np.asarray(p.data)[:n]
+                datas.append(rm[codes])
+                valids.append(np.ones(n, bool) if p.valid is None
+                              else np.asarray(p.valid)[:n])
+            data = np.concatenate(datas) if datas else np.zeros(0, np.int32)
+            valid = np.concatenate(valids)
+            cols[name] = Column(
+                typ, data.astype(np.int32),
+                None if valid.all() else valid, merged)
+        else:
+            for p, b in zip(parts, batches):
+                n = b.num_rows_host()
+                datas.append(np.asarray(p.data)[:n])
+                valids.append(np.ones(n, bool) if p.valid is None
+                              else np.asarray(p.valid)[:n])
+            data = np.concatenate(datas)
+            valid = np.concatenate(valids)
+            cols[name] = Column(typ, data,
+                                None if valid.all() else valid)
+    return pad_batch(Batch(cols, total), capacity_for(total))

@@ -1,0 +1,67 @@
+"""Global configuration for the TPU-native engine.
+
+The engine requires 64-bit types: SQL BIGINT is int64 and DOUBLE is float64
+(XLA emulates both on TPU; verified supported on v5e). This module must be
+imported before any jax.numpy use, so every entry point imports trino_tpu
+first.
+
+Reference parity: plays the role of Trino's FeaturesConfig / TaskManagerConfig
+(reference: core/trino-main/.../sql/analyzer/FeaturesConfig.java,
+execution/TaskManagerConfig.java) — a process-wide knob registry, with
+per-session overrides layered on top by ``trino_tpu.session.Session``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Process-wide engine configuration (Trino: etc/config.properties)."""
+
+    # Max rows per Batch flowing through a pipeline. Static-shape buckets are
+    # powers of two up to this; larger inputs are processed in chunks.
+    max_batch_rows: int = _env_int("TRINO_TPU_MAX_BATCH_ROWS", 1 << 22)
+    # Minimum physical capacity bucket, to bound the number of distinct
+    # compiled shapes (each bucket is a separate XLA compilation).
+    min_capacity: int = 1 << 10
+    # Default number of hash partitions for distributed exchanges
+    # (Trino: query.initial-hash-partitions, QueryManagerConfig.java:132).
+    hash_partition_count: int = _env_int("TRINO_TPU_HASH_PARTITIONS", 8)
+    # Per-query memory limit in bytes (Trino: query.max-memory-per-node).
+    max_query_memory_per_node: int = _env_int(
+        "TRINO_TPU_QUERY_MAX_MEMORY", 16 << 30
+    )
+    # Enable host spill when device memory is exhausted.
+    spill_enabled: bool = os.environ.get("TRINO_TPU_SPILL", "1") == "1"
+
+
+CONFIG = EngineConfig()
+
+
+def capacity_for(n: int, minimum: int | None = None) -> int:
+    """Round ``n`` up to a power-of-two capacity bucket.
+
+    Static shapes are mandatory under jit; bucketing keeps the number of
+    compiled variants logarithmic in data size (the analog of Trino compiling
+    one bytecode class per expression shape, ExpressionCompiler.java:56).
+    """
+    floor = CONFIG.min_capacity if minimum is None else minimum
+    cap = max(int(n), 1)
+    bucket = max(floor, 1)
+    while bucket < cap:
+        bucket <<= 1
+    return bucket

@@ -1,0 +1,5 @@
+"""Device kernels: the engine's operator library (reference:
+core/trino-main/src/main/java/io/trino/operator/ — 713 files), rebuilt as
+vectorized XLA programs."""
+
+from . import compact, groupby, hashing, join, sort  # noqa: F401

@@ -1,0 +1,55 @@
+"""Row selection & compaction — the FilterAndProject inner loop, TPU style.
+
+Reference parity: Trino's compiled PageFilter evaluates a predicate into a
+selected-positions array and PageProjection copies survivors
+(core/trino-main/.../operator/project/PageProcessor.java,
+sql/gen/PageFunctionCompiler.java:101). On TPU the same is a mask +
+stable-compaction gather, fused by XLA into the surrounding pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Batch
+
+
+def mask_to_gather(mask: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Turn a boolean row mask into (indices, count).
+
+    indices is capacity-length; the first ``count`` entries are the positions
+    of set bits in order; the rest point at position 0 (harmless garbage —
+    rows past count are dead by construction).
+    """
+    cap = mask.shape[0]
+    idx = jnp.nonzero(mask, size=cap, fill_value=0)[0]
+    count = jnp.sum(mask.astype(jnp.int64))
+    return idx, count
+
+
+def filter_batch(batch: Batch, mask: jax.Array) -> Batch:
+    """Keep rows where mask & live; output is compacted with a device
+    num_rows (data-dependent cardinality under static shapes)."""
+    live = mask & batch.row_valid()
+    idx, count = mask_to_gather(live)
+    return batch.gather(idx, count)
+
+
+def limit_batch(batch: Batch, limit: Union[int, jax.Array]) -> Batch:
+    """LIMIT n without data movement (reference: operator/LimitOperator.java).
+    """
+    n = jnp.minimum(batch.num_rows_device(),
+                    jnp.asarray(limit, dtype=jnp.int64))
+    return Batch(batch.columns, n)
+
+
+def offset_batch(batch: Batch, offset: Union[int, jax.Array]) -> Batch:
+    """OFFSET n — shift rows down (reference: sql/planner/plan/OffsetNode)."""
+    off = jnp.asarray(offset, dtype=jnp.int64)
+    cap = batch.capacity
+    idx = jnp.arange(cap, dtype=jnp.int64) + off
+    n = jnp.maximum(batch.num_rows_device() - off, 0)
+    return batch.gather(jnp.clip(idx, 0, cap - 1), n)

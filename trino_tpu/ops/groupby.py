@@ -1,0 +1,287 @@
+"""Grouped aggregation — HashAggregationOperator, TPU style.
+
+Reference parity: operator/HashAggregationOperator.java:49,381-413 with
+MultiChannelGroupByHash.java:55 (open-addressing probe) and flat BigArray
+accumulator state (operator/aggregation/, lib/trino-array). Redesign for
+XLA (SURVEY.md §7.3): instead of a serial hash-probe loop, group rows by a
+stable lexsort on the key lanes, derive segment ids from key-change
+boundaries, and compute every accumulator with ``jax.ops.segment_*`` —
+fully parallel, static shapes, no device hash table. Group cardinality is
+data-dependent, so outputs are capacity-padded with a device num_groups.
+
+Partial/final split (reference: AggregationNode PARTIAL/FINAL +
+PushPartialAggregationThroughExchange rule) is expressed by running this
+same kernel on partial states: every aggregate below declares a
+``combine`` that is itself one of the supported segment ops.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Batch, Column
+from .hashing import equality_lanes
+
+_U64MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+@dataclass(frozen=True)
+class AggInput:
+    """One aggregate over one input lane (or none, for count(*))."""
+    kind: str          # sum | count | count_star | min | max | any_value
+    input: Optional[str] = None   # column name; None for count_star
+    mask: Optional[str] = None    # FILTER / mask column (boolean), optional
+    output: str = "agg"
+
+
+def _key_lanes(batch: Batch, key_names: Sequence[str]) -> List[jax.Array]:
+    """Exact equality-preserving lanes; a null is its own group value
+    (SQL GROUP BY treats NULLs as equal), encoded via a validity lane."""
+    live = batch.row_valid()
+    lanes: List[jax.Array] = [(~live).astype(jnp.uint64)]
+    for name in key_names:
+        col = batch.column(name)
+        col_lanes = equality_lanes(col.data)
+        if col.valid is not None:
+            v = jnp.asarray(col.valid)
+            lanes.append((~v).astype(jnp.uint64))
+            col_lanes = [jnp.where(v, u, jnp.zeros_like(u))
+                         for u in col_lanes]
+        col_lanes = [jnp.where(live, u, _U64MAX + jnp.zeros_like(u))
+                     for u in col_lanes]
+        lanes.extend(col_lanes)
+    return lanes
+
+
+def _identity_for(kind: str, dtype) -> jax.Array:
+    if dtype == jnp.bool_:
+        return jnp.asarray(kind == "min", dtype)
+    if kind == "min":
+        if dtype in (jnp.float32, jnp.float64):
+            return jnp.asarray(jnp.inf, dtype)
+        return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+    if kind == "max":
+        if dtype in (jnp.float32, jnp.float64):
+            return jnp.asarray(-jnp.inf, dtype)
+        return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+    return jnp.asarray(0, dtype)
+
+
+def group_aggregate(batch: Batch, key_names: Sequence[str],
+                    aggs: Sequence[AggInput],
+                    groups_capacity: Optional[int] = None) -> Batch:
+    """GROUP BY key_names with the given aggregates.
+
+    Returns a Batch of key columns + aggregate columns, capacity-padded to
+    ``groups_capacity`` (default: input capacity) with device num_groups.
+    Aggregate null semantics: sum/min/max over zero non-null inputs yield
+    NULL; count yields 0 (SQL standard, matching reference
+    operator/aggregation/LongSumAggregation.java).
+    """
+    cap = batch.capacity
+    gcap = groups_capacity or cap
+    live = batch.row_valid()
+
+    lanes = _key_lanes(batch, key_names)
+    order = jnp.lexsort(lanes[::-1])
+    live_s = jnp.take(live, order)
+
+    # key-change boundaries over the sorted live prefix
+    changed = jnp.zeros((cap,), dtype=bool)
+    for lane in lanes[1:]:
+        s = jnp.take(lane, order)
+        changed = changed | (s != jnp.roll(s, 1))
+    first = jnp.arange(cap) == 0
+    boundary = (changed | first) & live_s
+    gid = jnp.cumsum(boundary.astype(jnp.int64)) - 1
+    num_groups = jnp.sum(boundary.astype(jnp.int64))
+    gid_c = jnp.clip(gid, 0, gcap - 1).astype(jnp.int32)
+
+    # first-row position of each group -> gather for key output
+    grp_first = jnp.nonzero(boundary, size=gcap, fill_value=0)[0]
+    grp_rows = jnp.take(order, grp_first)
+
+    out_cols: Dict[str, Column] = {}
+    for name in key_names:
+        out_cols[name] = batch.column(name).gather(grp_rows)
+
+    for agg in aggs:
+        out_cols[agg.output] = _segment_agg(
+            batch, agg, order, gid_c, live_s, gcap)
+
+    return Batch(out_cols, num_groups)
+
+
+def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
+                 gcap: int) -> Column:
+    from ..types import BIGINT, DOUBLE, is_string
+
+    extra_mask = None
+    if agg.mask is not None:
+        mcol = batch.column(agg.mask)
+        m = jnp.take(jnp.asarray(mcol.data).astype(bool), order)
+        if mcol.valid is not None:
+            m = m & jnp.take(jnp.asarray(mcol.valid), order)
+        extra_mask = m
+
+    if agg.kind == "count_star":
+        ones = live_s.astype(jnp.int64)
+        if extra_mask is not None:
+            ones = jnp.where(extra_mask, ones, 0)
+        data = jax.ops.segment_sum(ones, gid, num_segments=gcap)
+        return Column(BIGINT, data, None)
+
+    col = batch.column(agg.input)
+    vals = jnp.take(jnp.asarray(col.data), order)
+    valid = live_s if col.valid is None else (
+        live_s & jnp.take(jnp.asarray(col.valid), order))
+    if extra_mask is not None:
+        valid = valid & extra_mask
+
+    if agg.kind == "count":
+        data = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                   num_segments=gcap)
+        return Column(BIGINT, data, None)
+
+    nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
+                                 num_segments=gcap)
+    group_valid = nvalid > 0
+
+    if agg.kind == "sum":
+        acc_dtype = vals.dtype if vals.dtype in (
+            jnp.float32, jnp.float64) else jnp.int64
+        masked = jnp.where(valid, vals.astype(acc_dtype),
+                           jnp.asarray(0, acc_dtype))
+        data = jax.ops.segment_sum(masked, gid, num_segments=gcap)
+        return Column(_sum_type(col.type), data, group_valid)
+
+    if agg.kind in ("min", "max"):
+        seg = jax.ops.segment_min if agg.kind == "min" else \
+            jax.ops.segment_max
+        if is_string(col.type):
+            # min/max over collation ranks, then rank -> code
+            # (codes are insertion-ordered, not collation-ordered)
+            ranks = col.dictionary.rank_codes()
+            code_by_rank = jnp.asarray(
+                _invert_permutation(ranks))
+            rvals = jnp.take(jnp.asarray(ranks), vals, mode="clip")
+            ident = jnp.asarray(
+                len(ranks) if agg.kind == "min" else -1, rvals.dtype)
+            data = seg(jnp.where(valid, rvals, ident), gid,
+                       num_segments=gcap)
+            data = jnp.take(code_by_rank,
+                            jnp.clip(data, 0, len(ranks) - 1),
+                            mode="clip").astype(jnp.int32)
+            return Column(col.type, data, group_valid,
+                          dictionary=col.dictionary)
+        as_bool = vals.dtype == jnp.bool_
+        work = vals.astype(jnp.int32) if as_bool else vals
+        ident = _identity_for(agg.kind, work.dtype)
+        data = seg(jnp.where(valid, work, ident), gid,
+                   num_segments=gcap)
+        if as_bool:
+            data = data.astype(jnp.bool_)
+        return Column(col.type, data, group_valid)
+
+    if agg.kind == "any_value":
+        # first row of the group (null-ness preserved)
+        grp_first = jax.ops.segment_min(
+            jnp.arange(order.shape[0], dtype=jnp.int64), gid,
+            num_segments=gcap)
+        rows = jnp.take(order, jnp.clip(grp_first, 0, order.shape[0] - 1))
+        return col.gather(rows)
+
+    raise ValueError(f"unknown aggregate kind {agg.kind}")
+
+
+def _invert_permutation(ranks):
+    import numpy as np
+    inv = np.empty(len(ranks), dtype=np.int32)
+    inv[np.asarray(ranks)] = np.arange(len(ranks), dtype=np.int32)
+    return inv
+
+
+def _sum_type(t):
+    from ..types import BIGINT, DOUBLE, REAL, DecimalType, is_integral
+    if is_integral(t):
+        return BIGINT
+    if isinstance(t, DecimalType):
+        return DecimalType(38, t.scale)
+    if t.name == "real":
+        return REAL
+    return DOUBLE
+
+
+def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
+    """Aggregation without GROUP BY (reference: operator/
+    AggregationOperator.java) — masked full reductions, one output row."""
+    from ..types import BIGINT
+
+    live = batch.row_valid()
+    out: Dict[str, Column] = {}
+    for agg in aggs:
+        extra = None
+        if agg.mask is not None:
+            mcol = batch.column(agg.mask)
+            extra = jnp.asarray(mcol.data).astype(bool)
+            if mcol.valid is not None:
+                extra = extra & jnp.asarray(mcol.valid)
+        if agg.kind == "count_star":
+            m = live if extra is None else (live & extra)
+            out[agg.output] = Column(
+                BIGINT, jnp.sum(m.astype(jnp.int64))[None], None)
+            continue
+        col = batch.column(agg.input)
+        vals = jnp.asarray(col.data)
+        valid = live if col.valid is None else live & jnp.asarray(col.valid)
+        if extra is not None:
+            valid = valid & extra
+        n = jnp.sum(valid.astype(jnp.int64))
+        if agg.kind == "count":
+            out[agg.output] = Column(BIGINT, n[None], None)
+            continue
+        has = (n > 0)[None]
+        if agg.kind == "sum":
+            acc_dtype = vals.dtype if vals.dtype in (
+                jnp.float32, jnp.float64) else jnp.int64
+            s = jnp.sum(jnp.where(valid, vals.astype(acc_dtype),
+                                  jnp.asarray(0, acc_dtype)))[None]
+            out[agg.output] = Column(_sum_type(col.type), s, has)
+        elif agg.kind in ("min", "max"):
+            from ..types import is_string as _is_str
+            if _is_str(col.type):
+                ranks = col.dictionary.rank_codes()
+                code_by_rank = jnp.asarray(_invert_permutation(ranks))
+                rvals = jnp.take(jnp.asarray(ranks), vals, mode="clip")
+                ident = jnp.asarray(
+                    len(ranks) if agg.kind == "min" else -1, rvals.dtype)
+                masked = jnp.where(valid, rvals, ident)
+                r = (jnp.min(masked) if agg.kind == "min"
+                     else jnp.max(masked))
+                r = jnp.take(code_by_rank,
+                             jnp.clip(r, 0, len(ranks) - 1),
+                             mode="clip").astype(jnp.int32)[None]
+                out[agg.output] = Column(col.type, r, has,
+                                         dictionary=col.dictionary)
+            else:
+                as_bool = vals.dtype == jnp.bool_
+                work = vals.astype(jnp.int32) if as_bool else vals
+                ident = _identity_for(agg.kind, work.dtype)
+                masked = jnp.where(valid, work, ident)
+                r = (jnp.min(masked) if agg.kind == "min"
+                     else jnp.max(masked))[None]
+                if as_bool:
+                    r = r.astype(jnp.bool_)
+                out[agg.output] = Column(col.type, r, has)
+        elif agg.kind == "any_value":
+            idx = jnp.argmax(valid)  # first valid row (0 if none)
+            out[agg.output] = col.gather(idx[None])
+            out[agg.output] = Column(col.type, out[agg.output].data,
+                                     has, col.dictionary)
+        else:
+            raise ValueError(f"unknown aggregate kind {agg.kind}")
+    return Batch(out, 1)

@@ -1,0 +1,282 @@
+"""SQL type system, TPU-first.
+
+Mirrors the reference's type SPI (reference: core/trino-spi/src/main/java/io/
+trino/spi/type/ — 60+ classes) but each type here declares its *physical*
+device representation: the jnp dtype of the value lanes plus how NULLs and
+variable-width data are encoded. Design decisions (SURVEY.md §7.1):
+
+- Fixed-width SQL types map 1:1 onto a single dense ``jax.Array`` lane.
+- DECIMAL(p,s) with p<=18 is a scaled int64 ("short decimal",
+  reference: spi/type/DecimalType.java, Int128 only for p>18).
+- DECIMAL(p>18) is a pair of int64 lanes (hi, lo) emulating Int128.
+- VARCHAR/CHAR are dictionary-encoded: an int32 code lane per row plus a
+  host-side deduplicated dictionary (reference analog: spi/block/
+  DictionaryBlock.java made the *primary* representation, because equality/
+  group-by/join on codes is MXU/VPU-friendly while raw bytes are not).
+- DATE is days-since-epoch int32; TIMESTAMP(p) is an int64 of 10^-p units
+  since epoch (reference: spi/type/DateType.java, TimestampType.java).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "Type", "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT", "REAL",
+    "DOUBLE", "VARCHAR", "VARBINARY", "DATE", "UNKNOWN", "DecimalType",
+    "VarcharType", "CharType", "TimestampType", "ArrayType", "RowType",
+    "IntervalDayTime", "IntervalYearMonth", "parse_type", "common_super_type",
+    "is_numeric", "is_integral", "is_exact_numeric", "is_string",
+]
+
+
+@dataclass(frozen=True)
+class Type:
+    """Base SQL type. ``name`` is the SQL display name."""
+
+    name: str
+
+    # --- physical layout -------------------------------------------------
+    @property
+    def np_dtype(self) -> Optional[np.dtype]:
+        """dtype of the primary value lane, or None for multi-lane types."""
+        return _PHYSICAL.get(self.name)
+
+    @property
+    def lanes(self) -> int:
+        return 1
+
+    @property
+    def is_dictionary(self) -> bool:
+        return False
+
+    def __str__(self) -> str:  # SQL display form
+        return self.name
+
+    def display(self) -> str:
+        return self.name
+
+
+_PHYSICAL = {
+    "boolean": np.dtype(np.bool_),
+    "tinyint": np.dtype(np.int8),
+    "smallint": np.dtype(np.int16),
+    "integer": np.dtype(np.int32),
+    "bigint": np.dtype(np.int64),
+    "real": np.dtype(np.float32),
+    "double": np.dtype(np.float64),
+    "date": np.dtype(np.int32),
+    "interval day to second": np.dtype(np.int64),  # millis
+    "interval year to month": np.dtype(np.int32),  # months
+    "unknown": np.dtype(np.bool_),
+}
+
+
+@dataclass(frozen=True)
+class DecimalType(Type):
+    precision: int = 38
+    scale: int = 0
+
+    def __init__(self, precision: int, scale: int):
+        object.__setattr__(self, "name", f"decimal({precision},{scale})")
+        object.__setattr__(self, "precision", precision)
+        object.__setattr__(self, "scale", scale)
+        if not (1 <= precision <= 38):
+            raise ValueError(f"DECIMAL precision out of range: {precision}")
+        if not (0 <= scale <= precision):
+            raise ValueError(f"DECIMAL scale out of range: {scale}")
+
+    @property
+    def is_short(self) -> bool:
+        return self.precision <= 18
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+    @property
+    def lanes(self) -> int:
+        return 1 if self.is_short else 2
+
+
+@dataclass(frozen=True)
+class VarcharType(Type):
+    length: Optional[int] = None  # None == unbounded
+
+    def __init__(self, length: Optional[int] = None):
+        object.__setattr__(
+            self, "name",
+            "varchar" if length is None else f"varchar({length})")
+        object.__setattr__(self, "length", length)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)  # dictionary code lane
+
+    @property
+    def is_dictionary(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class CharType(Type):
+    length: int = 1
+
+    def __init__(self, length: int = 1):
+        object.__setattr__(self, "name", f"char({length})")
+        object.__setattr__(self, "length", length)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int32)
+
+    @property
+    def is_dictionary(self) -> bool:
+        return True
+
+
+@dataclass(frozen=True)
+class TimestampType(Type):
+    precision: int = 3
+
+    def __init__(self, precision: int = 3):
+        object.__setattr__(self, "name", f"timestamp({precision})")
+        object.__setattr__(self, "precision", precision)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class ArrayType(Type):
+    element: Type = None  # type: ignore
+
+    def __init__(self, element: Type):
+        object.__setattr__(self, "name", f"array({element.name})")
+        object.__setattr__(self, "element", element)
+
+
+@dataclass(frozen=True)
+class RowType(Type):
+    fields: Tuple[Tuple[Optional[str], Type], ...] = ()
+
+    def __init__(self, fields):
+        fields = tuple((n, t) for n, t in fields)
+        object.__setattr__(
+            self, "name",
+            "row(" + ", ".join(
+                (f"{n} {t.name}" if n else t.name) for n, t in fields) + ")")
+        object.__setattr__(self, "fields", fields)
+
+
+BOOLEAN = Type("boolean")
+TINYINT = Type("tinyint")
+SMALLINT = Type("smallint")
+INTEGER = Type("integer")
+BIGINT = Type("bigint")
+REAL = Type("real")
+DOUBLE = Type("double")
+DATE = Type("date")
+UNKNOWN = Type("unknown")  # type of NULL literal
+VARBINARY = Type("varbinary")
+VARCHAR = VarcharType(None)
+IntervalDayTime = Type("interval day to second")
+IntervalYearMonth = Type("interval year to month")
+
+
+def is_integral(t: Type) -> bool:
+    return t.name in ("tinyint", "smallint", "integer", "bigint")
+
+
+def is_exact_numeric(t: Type) -> bool:
+    return is_integral(t) or isinstance(t, DecimalType)
+
+
+def is_numeric(t: Type) -> bool:
+    return is_exact_numeric(t) or t.name in ("real", "double")
+
+
+def is_string(t: Type) -> bool:
+    return isinstance(t, (VarcharType, CharType))
+
+
+_NUMERIC_LADDER = ["tinyint", "smallint", "integer", "bigint", "real",
+                   "double"]
+
+
+def default_decimal_for(t: Type) -> DecimalType:
+    return {
+        "tinyint": DecimalType(3, 0), "smallint": DecimalType(5, 0),
+        "integer": DecimalType(10, 0), "bigint": DecimalType(19, 0),
+    }[t.name]
+
+
+def common_super_type(a: Type, b: Type) -> Optional[Type]:
+    """The implicit-coercion join of two types (reference:
+    core/trino-main/.../type/TypeCoercion.java)."""
+    if a == b:
+        return a
+    if a == UNKNOWN:
+        return b
+    if b == UNKNOWN:
+        return a
+    if is_string(a) and is_string(b):
+        return VARCHAR
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        if a.name in ("double",) or b.name in ("double",):
+            return DOUBLE
+        if a.name in ("real",) or b.name in ("real",):
+            return REAL
+        da = a if isinstance(a, DecimalType) else (
+            default_decimal_for(a) if is_integral(a) else None)
+        db = b if isinstance(b, DecimalType) else (
+            default_decimal_for(b) if is_integral(b) else None)
+        if da is None or db is None:
+            return None
+        scale = max(da.scale, db.scale)
+        ip = max(da.precision - da.scale, db.precision - db.scale)
+        return DecimalType(min(38, ip + scale), scale)
+    if is_numeric(a) and is_numeric(b):
+        ia, ib = _NUMERIC_LADDER.index(a.name), _NUMERIC_LADDER.index(b.name)
+        return a if ia >= ib else b
+    if a == DATE and isinstance(b, TimestampType):
+        return b
+    if b == DATE and isinstance(a, TimestampType):
+        return a
+    return None
+
+
+_TYPE_RE = re.compile(r"^\s*([a-z_ ]+?)\s*(?:\(\s*([0-9]+)\s*(?:,\s*([0-9]+)\s*)?\))?\s*$")
+
+_SIMPLE = {t.name: t for t in [
+    BOOLEAN, TINYINT, SMALLINT, INTEGER, BIGINT, REAL, DOUBLE, DATE,
+    VARBINARY, UNKNOWN, IntervalDayTime, IntervalYearMonth]}
+_SIMPLE["int"] = INTEGER
+_SIMPLE["string"] = VARCHAR
+_SIMPLE["varchar"] = VARCHAR
+_SIMPLE["timestamp"] = TimestampType(3)
+
+
+def parse_type(s: str) -> Type:
+    """Parse a SQL type name, e.g. 'decimal(12,2)' (reference:
+    core/trino-main/.../type/TypeRegistry.java)."""
+    m = _TYPE_RE.match(s.lower())
+    if not m:
+        raise ValueError(f"cannot parse type: {s!r}")
+    base, p1, p2 = m.group(1), m.group(2), m.group(3)
+    if base in _SIMPLE and p1 is None:
+        return _SIMPLE[base]
+    if base == "decimal":
+        return DecimalType(int(p1 or 38), int(p2 or 0))
+    if base == "varchar":
+        return VarcharType(int(p1)) if p1 else VARCHAR
+    if base == "char":
+        return CharType(int(p1 or 1))
+    if base == "timestamp":
+        return TimestampType(int(p1) if p1 else 3)
+    raise ValueError(f"unknown type: {s!r}")
