@@ -203,13 +203,15 @@ def test_concat_batches_merges_dictionaries():
 def test_decimal_column():
     b = batch_from_pylist({"d": [1.25, 2.50, None]},
                           {"d": DecimalType(10, 2)})
-    assert b.to_pylist() == [[1.25], [2.5], [None]]
+    import decimal
+    assert b.to_pylist() == [[decimal.Decimal("1.25")], [decimal.Decimal("2.5")], [None]]
 
 
 def test_decimal_half_up_rounding():
     # 1.115 * 100 == 111.4999... in binary floats; must store 112
     b = batch_from_pylist({"d": [1.115]}, {"d": DecimalType(10, 2)})
-    assert b.to_pylist() == [[1.12]]
+    import decimal
+    assert b.to_pylist() == [[decimal.Decimal("1.12")]]
 
 
 def test_string_join_across_dictionaries():
@@ -228,3 +230,25 @@ def test_string_min_max_uses_collation():
     assert out.to_pylist() == [[1, "a", "b"]]
     gout = global_aggregate(b, [AggInput("min", "s", output="mn")])
     assert gout.to_pylist() == [["a"]]
+
+
+def test_long_decimal_int128_roundtrip():
+    import decimal
+    from trino_tpu.columnar import concat_batches
+    big = 12345678901234567890123456789
+    b1 = batch_from_pylist({"d": [big, -big]}, {"d": DecimalType(38, 0)})
+    assert b1.to_pylist() == [[big], [-big]]
+    b2 = batch_from_pylist({"d": [5]}, {"d": DecimalType(38, 0)})
+    assert concat_batches([b1, b2]).to_pylist() == [[big], [-big], [5]]
+    d = batch_from_pylist({"d": ["12345678901234567.89"]},
+                          {"d": DecimalType(38, 2)})
+    assert d.to_pylist()[0][0] == decimal.Decimal("12345678901234567.89")
+
+
+def test_grouped_any_value_skips_nulls():
+    from trino_tpu.ops.groupby import AggInput, group_aggregate
+    b = batch_from_pylist({"k": [1, 1, 2], "x": [None, 7.0, None]},
+                          {"k": BIGINT, "x": DOUBLE})
+    out = group_aggregate(b, ["k"],
+                          [AggInput("any_value", "x", output="a")])
+    assert out.to_pylist() == [[1, 7.0], [2, None]]

@@ -1,0 +1,164 @@
+"""Parser tests (reference model: core/trino-parser tests,
+io/trino/sql/parser/TestSqlParser.java — same coverage intent, new cases)."""
+
+import pytest
+
+from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+from trino_tpu.sql import ast as A
+from trino_tpu.sql.parser import parse_expression, parse_statement
+from trino_tpu.sql.tokenizer import ParseError, tokenize
+
+
+def test_tokenizer_basics():
+    toks = tokenize("SELECT a_b, 'it''s', \"Q\" -- c\n1.5 /*x*/ <> 2e3")
+    kinds = [(t.kind, t.value) for t in toks]
+    assert ("ident", "select") in kinds
+    assert ("string", "it's") in kinds
+    assert ("qident", "Q") in kinds
+    assert ("decimal", "1.5") in kinds
+    assert ("float", "2e3") in kinds
+    assert ("op", "<>") in kinds
+
+
+@pytest.mark.parametrize("qid", sorted(TPCH_QUERIES))
+def test_parse_all_tpch(qid):
+    stmt = parse_statement(TPCH_QUERIES[qid])
+    assert isinstance(stmt, A.QueryStatement)
+
+
+def test_precedence():
+    e = parse_expression("1 + 2 * 3")
+    assert isinstance(e, A.BinaryOp) and e.op == "+"
+    assert isinstance(e.right, A.BinaryOp) and e.right.op == "*"
+    e = parse_expression("a or b and not c = d")
+    assert e.op == "or"
+    assert e.right.op == "and"
+    assert isinstance(e.right.right, A.UnaryOp)
+
+
+def test_between_and_in():
+    e = parse_expression("x between 1 and 2 or y in (3, 4)")
+    assert e.op == "or"
+    assert isinstance(e.left, A.Between)
+    assert isinstance(e.right, A.InList)
+    e = parse_expression("x not in (select y from t)")
+    assert isinstance(e, A.InSubquery) and e.negated
+
+
+def test_case_desugar():
+    e = parse_expression("case x when 1 then 'a' else 'b' end")
+    assert isinstance(e, A.Case)
+    cond = e.whens[0][0]
+    assert isinstance(cond, A.BinaryOp) and cond.op == "="
+
+
+def test_join_tree():
+    s = parse_statement(
+        "select * from a join b on a.x = b.x left join c using (y)")
+    spec = s.query.body
+    j = spec.from_
+    assert isinstance(j, A.Join) and j.join_type == "left"
+    assert j.using == ("y",)
+    assert isinstance(j.left, A.Join) and j.left.join_type == "inner"
+
+
+def test_implicit_cross_join():
+    s = parse_statement("select * from a, b, c")
+    j = s.query.body.from_
+    assert isinstance(j, A.Join) and j.join_type == "cross"
+
+
+def test_window():
+    s = parse_statement(
+        "select sum(x) over (partition by g order by t "
+        "rows between 2 preceding and current row) from t")
+    f = s.query.body.select_items[0].expr
+    assert f.window is not None
+    assert f.window.frame.unit == "rows"
+    assert f.window.frame.start_type == "preceding"
+
+
+def test_set_ops_and_with():
+    s = parse_statement(
+        "with t as (select 1 x) select x from t union all "
+        "select 2 order by 1 limit 5")
+    q = s.query
+    assert isinstance(q.body, A.SetOperation)
+    assert not q.body.distinct
+    assert q.limit == 5
+    assert q.with_queries[0].name == "t"
+
+
+def test_grouping_sets():
+    s = parse_statement("select a, b, sum(c) from t group by rollup (a, b)")
+    g = s.query.body.group_by
+    assert len(g.sets) == 3
+    s = parse_statement("select a, b from t group by cube (a, b)")
+    assert len(s.query.body.group_by.sets) == 4
+    s = parse_statement(
+        "select a, b from t group by grouping sets ((a), (a, b), ())")
+    assert len(s.query.body.group_by.sets) == 3
+
+
+def test_statements():
+    assert isinstance(parse_statement("show catalogs"), A.ShowCatalogs)
+    assert isinstance(parse_statement("explain select 1"), A.Explain)
+    st = parse_statement("set session a.b = 4")
+    assert isinstance(st, A.SetSession) and st.name == "a.b"
+    ct = parse_statement(
+        "create table t (a bigint not null, b decimal(10,2))")
+    assert ct.columns[1].type_name == "decimal(10,2)"
+    ins = parse_statement("insert into t select * from u")
+    assert isinstance(ins, A.Insert)
+    d = parse_statement("delete from t where x = 1")
+    assert isinstance(d, A.Delete) and d.where is not None
+    u = parse_statement("use tpch.sf1")
+    assert u.catalog == "tpch" and u.schema == "sf1"
+
+
+def test_errors():
+    with pytest.raises(ParseError):
+        parse_statement("select * frm t")
+    with pytest.raises(ParseError):
+        parse_statement("select 'unterminated")
+    with pytest.raises(ParseError):
+        parse_statement("select a from t join u")  # missing ON/USING
+
+
+def test_quoted_identifiers_preserve_case():
+    s = parse_statement('select "MixedCase" from "T"')
+    item = s.query.body.select_items[0].expr
+    assert item.parts == ("MixedCase",)
+
+
+def test_literals():
+    assert parse_expression("date '2020-01-02'") == A.Literal(
+        "2020-01-02", "date")
+    iv = parse_expression("interval '3' month")
+    assert isinstance(iv, A.IntervalLiteral) and iv.unit == "month"
+    assert parse_expression("null") == A.Literal(None)
+    assert parse_expression("1.5").type_name == "decimal"
+
+
+def test_intersect_binds_tighter_than_union():
+    s = parse_statement("select 1 union select 2 intersect select 3")
+    b = s.query.body
+    assert b.op == "union"
+    assert b.right.op == "intersect"
+
+
+def test_is_true_three_valued():
+    e = parse_expression("x is not true")
+    assert isinstance(e, A.IsDistinctFrom) and not e.negated
+    e = parse_expression("x is true")
+    assert isinstance(e, A.IsDistinctFrom) and e.negated
+
+
+def test_nested_type_names():
+    s = parse_statement("select cast(x as array(decimal(10,2))) from t")
+    assert s.query.body.select_items[0].expr.type_name == \
+        "array(decimal(10,2))"
+    s = parse_statement("select cast(x as map(varchar, array(bigint))) "
+                        "from t")
+    assert s.query.body.select_items[0].expr.type_name == \
+        "map(varchar, array(bigint))"

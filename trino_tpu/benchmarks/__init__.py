@@ -1,0 +1,7 @@
+"""Benchmark workloads (TPC-H / TPC-DS query texts and harnesses).
+
+Reference parity: testing/trino-benchto-benchmarks (macro SQL suites) and
+testing/trino-benchmark (hand-coded operator pipelines).
+"""
+
+from .tpch_queries import TPCH_QUERIES  # noqa: F401

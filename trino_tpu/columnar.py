@@ -175,32 +175,42 @@ class Column:
 
 def _to_lane(values, typ: Type):
     """numpy-ify a python sequence for a non-string column; returns
-    (data, valid|None)."""
+    (data, valid|None, data2|None). ``data2`` is the Int128 high lane,
+    present only for DECIMAL(p>18)."""
     dt = typ.np_dtype
     n = len(values)
     data = np.zeros(n, dtype=dt)
     valid = np.ones(n, dtype=bool)
     any_null = False
+    long_decimal = isinstance(typ, DecimalType) and not typ.is_short
+    data2 = np.zeros(n, dtype=np.int64) if long_decimal else None
     for i, v in enumerate(values):
         if v is None:
             valid[i] = False
             any_null = True
         elif isinstance(typ, DecimalType):
             if isinstance(v, int):
-                data[i] = v * (10 ** typ.scale)
+                q = v * (10 ** typ.scale)
             else:
                 # exact decimal scaling with HALF_UP (Trino rounding,
                 # reference: spi/type/Decimals.java) — going through
                 # binary float multiply would be off-by-one near .5
                 import decimal
-                q = decimal.Decimal(str(v)).scaleb(typ.scale).to_integral_value(
-                    rounding=decimal.ROUND_HALF_UP)
-                data[i] = int(q)
+                q = int(decimal.Decimal(str(v)).scaleb(typ.scale)
+                        .to_integral_value(rounding=decimal.ROUND_HALF_UP))
+            if long_decimal:
+                # two's-complement split: lo = unsigned low 64 bits
+                # (stored in an int64 lane), hi carries the sign
+                lo = q & ((1 << 64) - 1)
+                data[i] = lo - (1 << 64) if lo >= (1 << 63) else lo
+                data2[i] = q >> 64
+            else:
+                data[i] = q
         elif typ is BOOLEAN or typ.name == "boolean":
             data[i] = bool(v)
         else:
             data[i] = v
-    return data, (valid if any_null else None)
+    return data, (valid if any_null else None), data2
 
 
 def column_from_pylist(values: Sequence, typ: Type) -> Column:
@@ -211,8 +221,8 @@ def column_from_pylist(values: Sequence, typ: Type) -> Column:
         valid = np.asarray([v is not None for v in values], dtype=bool)
         return Column(typ, codes,
                       None if valid.all() else valid, dictionary)
-    data, valid = _to_lane(values, typ)
-    return Column(typ, data, valid)
+    data, valid, data2 = _to_lane(values, typ)
+    return Column(typ, data, valid, data2=data2)
 
 
 def column_from_numpy(arr: np.ndarray, typ: Type,
@@ -292,19 +302,26 @@ class Batch:
                     if (col[-1] is not None and isinstance(t, CharType)):
                         col[-1] = col[-1].ljust(t.length)
             elif isinstance(t, DecimalType):
+                import decimal as _dec
                 s = t.scale
+                hi = None if c.data2 is None else np.asarray(c.data2)[:n]
                 for i in range(n):
                     if not valid[i]:
                         col.append(None)
                     else:
-                        if c.data2 is not None:
+                        if hi is not None:
                             # (hi, lo) two's-complement Int128: lo is the
                             # unsigned low 64 bits, hi carries the sign
                             lo = int(data[i]) & ((1 << 64) - 1)
-                            q = (int(np.asarray(c.data2)[i]) << 64) + lo
+                            q = (int(hi[i]) << 64) + lo
                         else:
                             q = int(data[i])
-                        col.append(q / (10 ** s) if s else q)
+                        # type-stable exact materialization: int for
+                        # scale 0, decimal.Decimal otherwise (the client
+                        # layer formats; reference: client decimals are
+                        # exact strings, FixJsonDataUtils.java)
+                        col.append(q if not s
+                                   else _dec.Decimal(q).scaleb(-s))
             elif t.name == "boolean":
                 col = [bool(data[i]) if valid[i] else None for i in range(n)]
             elif t.name in ("real", "double"):
@@ -399,13 +416,25 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
                 typ, data.astype(np.int32),
                 None if valid.all() else valid, merged)
         else:
+            has_hi = any(p.data2 is not None for p in parts)
+            his = []
             for p, b in zip(parts, batches):
                 n = b.num_rows_host()
                 datas.append(np.asarray(p.data)[:n])
                 valids.append(np.ones(n, bool) if p.valid is None
                               else np.asarray(p.valid)[:n])
+                if has_hi:
+                    if p.data2 is not None:
+                        his.append(np.asarray(p.data2)[:n])
+                    else:
+                        # short-decimal part: hi lane is the sign extension
+                        lo = np.asarray(p.data)[:n]
+                        his.append(np.where(lo < 0, np.int64(-1),
+                                            np.int64(0)))
             data = np.concatenate(datas)
             valid = np.concatenate(valids)
             cols[name] = Column(typ, data,
-                                None if valid.all() else valid)
+                                None if valid.all() else valid,
+                                data2=(np.concatenate(his) if has_hi
+                                       else None))
     return pad_batch(Batch(cols, total), capacity_for(total))

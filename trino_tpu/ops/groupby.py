@@ -46,6 +46,9 @@ def _key_lanes(batch: Batch, key_names: Sequence[str]) -> List[jax.Array]:
     for name in key_names:
         col = batch.column(name)
         col_lanes = equality_lanes(col.data)
+        if col.data2 is not None:
+            # Int128 high lane participates in key equality
+            col_lanes = col_lanes + equality_lanes(col.data2)
         if col.valid is not None:
             v = jnp.asarray(col.valid)
             lanes.append((~v).astype(jnp.uint64))
@@ -136,6 +139,12 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         return Column(BIGINT, data, None)
 
     col = batch.column(agg.input)
+    if col.data2 is not None and agg.kind in ("sum", "min", "max"):
+        # Int128 lane arithmetic (carry-propagating segment sums) is not
+        # implemented yet — fail loudly rather than reduce the lo lane
+        # (SURVEY.md §7 hard part 4)
+        raise NotImplementedError(
+            f"{agg.kind} over DECIMAL(p>18) is not supported yet")
     vals = jnp.take(jnp.asarray(col.data), order)
     valid = live_s if col.valid is None else (
         live_s & jnp.take(jnp.asarray(col.valid), order))
@@ -188,12 +197,15 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         return Column(col.type, data, group_valid)
 
     if agg.kind == "any_value":
-        # first row of the group (null-ness preserved)
+        # first VALID row of the group (respecting FILTER mask); NULL only
+        # when the group has no valid value — matches global_aggregate
+        cap = order.shape[0]
+        pos = jnp.arange(cap, dtype=jnp.int64)
         grp_first = jax.ops.segment_min(
-            jnp.arange(order.shape[0], dtype=jnp.int64), gid,
-            num_segments=gcap)
-        rows = jnp.take(order, jnp.clip(grp_first, 0, order.shape[0] - 1))
-        return col.gather(rows)
+            jnp.where(valid, pos, jnp.int64(cap)), gid, num_segments=gcap)
+        rows = jnp.take(order, jnp.clip(grp_first, 0, cap - 1))
+        from dataclasses import replace as _replace
+        return _replace(col.gather(rows), valid=group_valid)
 
     raise ValueError(f"unknown aggregate kind {agg.kind}")
 
@@ -236,6 +248,9 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
                 BIGINT, jnp.sum(m.astype(jnp.int64))[None], None)
             continue
         col = batch.column(agg.input)
+        if col.data2 is not None and agg.kind in ("sum", "min", "max"):
+            raise NotImplementedError(
+                f"{agg.kind} over DECIMAL(p>18) is not supported yet")
         vals = jnp.asarray(col.data)
         valid = live if col.valid is None else live & jnp.asarray(col.valid)
         if extra is not None:
@@ -278,10 +293,9 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
                     r = r.astype(jnp.bool_)
                 out[agg.output] = Column(col.type, r, has)
         elif agg.kind == "any_value":
+            from dataclasses import replace as _replace
             idx = jnp.argmax(valid)  # first valid row (0 if none)
-            out[agg.output] = col.gather(idx[None])
-            out[agg.output] = Column(col.type, out[agg.output].data,
-                                     has, col.dictionary)
+            out[agg.output] = _replace(col.gather(idx[None]), valid=has)
         else:
             raise ValueError(f"unknown aggregate kind {agg.kind}")
     return Batch(out, 1)
