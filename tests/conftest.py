@@ -3,17 +3,25 @@
 Mirrors the reference's test strategy tier 2 (SURVEY.md §4):
 LocalQueryRunner-style in-process tests, multi-"node" via
 xla_force_host_platform_device_count instead of real chips.
+
+Note: a TPU-attached shell may force-select the tunnel backend by calling
+jax.config.update("jax_platforms", ...) at interpreter start, so setting
+the JAX_PLATFORMS env var alone is NOT enough — we call config.update
+ourselves before the first backend initialization.
 """
 
 import os
 
-# Force CPU for unit tests even when launched from a TPU-attached shell;
-# set TRINO_TPU_TEST_PLATFORM to override (e.g. to run the suite on chip).
-os.environ["JAX_PLATFORMS"] = os.environ.get(
-    "TRINO_TPU_TEST_PLATFORM", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force CPU for unit tests even when launched from a TPU-attached shell;
+# set TRINO_TPU_TEST_PLATFORM to override (e.g. to run the suite on chip).
+jax.config.update("jax_platforms",
+                  os.environ.get("TRINO_TPU_TEST_PLATFORM", "cpu"))
 
 import trino_tpu  # noqa: E402,F401  (enables x64)

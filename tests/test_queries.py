@@ -1,0 +1,342 @@
+"""End-to-end SQL tests on the tpch tiny catalog.
+
+Reference parity: testing/trino-testing AbstractTestQueries +
+H2QueryRunner cross-checking (SURVEY.md §4 tier 2) — here the oracle is
+independent numpy computation over the same generated columns.
+"""
+
+import math
+
+import numpy as np
+import pytest
+
+from trino_tpu.catalog import TableHandle
+from trino_tpu.connectors.tpch import TpchConnector
+from trino_tpu.exec import QueryError
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+@pytest.fixture(scope="module")
+def lineitem_np():
+    """Raw tiny lineitem columns via the connector, as numpy."""
+    c = TpchConnector()
+    h = TableHandle("tpch", "tiny", "lineitem")
+    cols = ["l_orderkey", "l_quantity", "l_extendedprice", "l_discount",
+            "l_tax", "l_shipdate", "l_returnflag", "l_linestatus"]
+    batches = [c.read_split(s, cols) for s in c.get_splits(h)]
+    out = {}
+    for name in cols:
+        parts = []
+        for b in batches:
+            n = b.num_rows_host()
+            col = b.column(name)
+            if col.dictionary is not None:
+                vals = col.dictionary.values
+                parts.append(np.asarray(
+                    [vals[i] for i in np.asarray(col.data)[:n]],
+                    dtype=object))
+            else:
+                parts.append(np.asarray(col.data)[:n])
+        out[name] = np.concatenate(parts)
+    return out
+
+
+def test_scan_sum(runner, lineitem_np):
+    res = runner.execute(
+        "SELECT sum(l_extendedprice) FROM tpch.tiny.lineitem")
+    expected = float(np.sum(lineitem_np["l_extendedprice"]))
+    assert res.columns == ["sum"]
+    assert res.rows[0][0] == pytest.approx(expected, rel=1e-12)
+
+
+def test_count_star(runner, lineitem_np):
+    res = runner.execute("SELECT count(*) FROM lineitem")
+    assert res.rows[0][0] == len(lineitem_np["l_orderkey"])
+
+
+def test_filter_where(runner, lineitem_np):
+    res = runner.execute(
+        "SELECT count(*) FROM lineitem WHERE l_quantity > 45")
+    expected = int(np.sum(lineitem_np["l_quantity"] > 45))
+    assert res.rows[0][0] == expected
+
+
+def test_group_by(runner, lineitem_np):
+    res = runner.execute(
+        "SELECT l_returnflag, count(*) AS c, sum(l_quantity) AS q "
+        "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag")
+    flags = lineitem_np["l_returnflag"]
+    qty = lineitem_np["l_quantity"]
+    expected = sorted(
+        (f, int(np.sum(flags == f)), float(qty[flags == f].sum()))
+        for f in set(flags))
+    assert len(res.rows) == len(expected)
+    for row, (f, c, q) in zip(res.rows, expected):
+        assert row[0] == f
+        assert row[1] == c
+        assert row[2] == pytest.approx(q, rel=1e-12)
+
+
+def test_avg_null_semantics(runner):
+    res = runner.execute(
+        "SELECT avg(x), count(x), count(*), sum(x), min(x), max(x) "
+        "FROM (VALUES (1), (2), (NULL), (5)) AS t(x)")
+    row = res.rows[0]
+    assert row == [pytest.approx(8 / 3), 3, 4, 8, 1, 5]
+
+
+def test_all_null_aggregates(runner):
+    res = runner.execute(
+        "SELECT sum(x), min(x), count(x) FROM "
+        "(VALUES (CAST(NULL AS integer)), (NULL)) AS t(x)")
+    assert res.rows[0] == [None, None, 0]
+
+
+def test_expressions(runner):
+    res = runner.execute(
+        "SELECT 1 + 2 * 3, 10 / 3, 10 % 3, -abs(-5), "
+        "CASE WHEN 1 > 2 THEN 'a' ELSE 'b' END, "
+        "coalesce(NULL, NULL, 7), nullif(3, 3)")
+    assert res.rows[0] == [7, 3, 1, -5, "b", 7, None]
+
+
+def test_three_valued_logic(runner):
+    res = runner.execute(
+        "SELECT (NULL AND false), (NULL AND true), (NULL OR true), "
+        "(NULL OR false), (NOT NULL) "
+        "FROM (VALUES (1)) AS t(x)")
+    assert res.rows[0] == [False, None, True, None, None]
+
+
+def test_inner_join(runner):
+    res = runner.execute("""
+        SELECT o.o_orderkey, count(*) AS n
+        FROM orders o JOIN lineitem l ON o.o_orderkey = l.l_orderkey
+        GROUP BY o.o_orderkey ORDER BY n DESC, o.o_orderkey LIMIT 5
+    """)
+    assert len(res.rows) == 5
+    assert res.rows[0][1] == 7  # max lineitems per order
+
+
+def test_join_row_counts(runner, lineitem_np):
+    res = runner.execute(
+        "SELECT count(*) FROM orders o, lineitem l "
+        "WHERE o.o_orderkey = l.l_orderkey")
+    # every lineitem has exactly one order
+    assert res.rows[0][0] == len(lineitem_np["l_orderkey"])
+
+
+def test_left_join_nulls(runner):
+    res = runner.execute("""
+        SELECT t.x, u.y FROM (VALUES (1), (2), (3)) AS t(x)
+        LEFT JOIN (VALUES (1, 'a'), (3, 'c')) AS u(y2, y)
+        ON t.x = u.y2 ORDER BY t.x
+    """)
+    assert res.rows == [[1, "a"], [2, None], [3, "c"]]
+
+
+def test_semi_join_in(runner):
+    res = runner.execute("""
+        SELECT count(*) FROM orders
+        WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                             WHERE l_quantity = 50)
+    """)
+    ref = runner.execute("""
+        SELECT count(DISTINCT l_orderkey) FROM lineitem
+        WHERE l_quantity = 50
+    """)
+    assert res.rows[0][0] == ref.rows[0][0]
+
+
+def test_in_null_semantics(runner):
+    res = runner.execute("""
+        SELECT x, x IN (SELECT y FROM (VALUES (1), (NULL)) AS s(y))
+        FROM (VALUES (1), (2)) AS t(x) ORDER BY x
+    """)
+    assert res.rows == [[1, True], [2, None]]
+
+
+def test_exists_correlated(runner):
+    res = runner.execute("""
+        SELECT count(*) FROM orders o
+        WHERE EXISTS (SELECT 1 FROM lineitem l
+                      WHERE l.l_orderkey = o.o_orderkey
+                        AND l.l_quantity = 50)
+    """)
+    ref = runner.execute("""
+        SELECT count(DISTINCT l_orderkey) FROM lineitem
+        WHERE l_quantity = 50
+    """)
+    assert res.rows[0][0] == ref.rows[0][0]
+
+
+def test_scalar_subquery_uncorrelated(runner, lineitem_np):
+    res = runner.execute("""
+        SELECT count(*) FROM lineitem
+        WHERE l_quantity > (SELECT avg(l_quantity) FROM lineitem)
+    """)
+    avg = lineitem_np["l_quantity"].mean()
+    assert res.rows[0][0] == int(np.sum(lineitem_np["l_quantity"] > avg))
+
+
+def test_scalar_subquery_correlated(runner):
+    # q17-style: per-part average
+    res = runner.execute("""
+        SELECT count(*) FROM lineitem l1
+        WHERE l1.l_quantity < (
+            SELECT avg(l2.l_quantity) FROM lineitem l2
+            WHERE l2.l_orderkey = l1.l_orderkey)
+    """)
+    ref = runner.execute("""
+        SELECT count(*) FROM lineitem l1 JOIN (
+            SELECT l_orderkey, avg(l_quantity) AS a FROM lineitem
+            GROUP BY l_orderkey) t ON l1.l_orderkey = t.l_orderkey
+        WHERE l1.l_quantity < t.a
+    """)
+    assert res.rows[0][0] == ref.rows[0][0]
+
+
+def test_order_by_limit_offset(runner):
+    res = runner.execute(
+        "SELECT x FROM (VALUES (3), (1), (2), (5), (4)) AS t(x) "
+        "ORDER BY x DESC LIMIT 2 OFFSET 1")
+    assert res.rows == [[4], [3]]
+
+
+def test_distinct(runner):
+    res = runner.execute(
+        "SELECT DISTINCT l_linestatus FROM lineitem ORDER BY 1")
+    assert res.rows == [["F"], ["O"]]
+
+
+def test_union(runner):
+    res = runner.execute(
+        "SELECT x FROM (VALUES (1), (2)) AS t(x) UNION "
+        "SELECT y FROM (VALUES (2), (3)) AS u(y) ORDER BY 1")
+    assert res.rows == [[1], [2], [3]]
+    res = runner.execute(
+        "SELECT x FROM (VALUES (1), (2)) AS t(x) UNION ALL "
+        "SELECT y FROM (VALUES (2)) AS u(y) ORDER BY 1")
+    assert res.rows == [[1], [2], [2]]
+
+
+def test_intersect_except(runner):
+    res = runner.execute(
+        "SELECT x FROM (VALUES (1), (2), (3)) AS t(x) INTERSECT "
+        "SELECT y FROM (VALUES (2), (3), (4)) AS u(y) ORDER BY 1")
+    assert res.rows == [[2], [3]]
+    res = runner.execute(
+        "SELECT x FROM (VALUES (1), (2), (3)) AS t(x) EXCEPT "
+        "SELECT y FROM (VALUES (2)) AS u(y) ORDER BY 1")
+    assert res.rows == [[1], [3]]
+
+
+def test_like(runner):
+    res = runner.execute(
+        "SELECT count(*) FROM part WHERE p_type LIKE '%BRASS'")
+    assert res.rows[0][0] > 0
+    res2 = runner.execute(
+        "SELECT count(*) FROM part WHERE p_type LIKE 'STANDARD%BRASS'")
+    assert 0 < res2.rows[0][0] < res.rows[0][0]
+
+
+def test_date_arithmetic(runner):
+    res = runner.execute("""
+        SELECT date '1998-12-01' - interval '90' day,
+               date '1998-01-31' + interval '1' month,
+               year(date '1995-06-17'), month(date '1995-06-17'),
+               day(date '1995-06-17'), quarter(date '1995-06-17')
+    """)
+    import datetime
+    row = res.rows[0]
+    assert row[0] == datetime.date(1998, 9, 2).toordinal() - \
+        datetime.date(1970, 1, 1).toordinal()
+    assert row[1] == datetime.date(1998, 2, 28).toordinal() - \
+        datetime.date(1970, 1, 1).toordinal()
+    assert row[2:] == [1995, 6, 17, 2]
+
+
+def test_between(runner, lineitem_np):
+    res = runner.execute(
+        "SELECT count(*) FROM lineitem "
+        "WHERE l_discount BETWEEN 0.05 AND 0.07")
+    d = lineitem_np["l_discount"]
+    assert res.rows[0][0] == int(np.sum((d >= 0.05) & (d <= 0.07)))
+
+
+def test_having(runner):
+    res = runner.execute("""
+        SELECT l_orderkey, count(*) AS c FROM lineitem
+        GROUP BY l_orderkey HAVING count(*) >= 7
+        ORDER BY l_orderkey LIMIT 3
+    """)
+    for row in res.rows:
+        assert row[1] == 7
+
+
+def test_cte(runner):
+    res = runner.execute("""
+        WITH big AS (SELECT * FROM lineitem WHERE l_quantity = 50)
+        SELECT count(*) FROM big
+    """)
+    ref = runner.execute(
+        "SELECT count(*) FROM lineitem WHERE l_quantity = 50")
+    assert res.rows[0][0] == ref.rows[0][0]
+
+
+def test_cross_check_error_messages(runner):
+    with pytest.raises(QueryError, match="cannot be resolved"):
+        runner.execute("SELECT nosuchcol FROM lineitem")
+    with pytest.raises(QueryError, match="GROUP BY"):
+        runner.execute(
+            "SELECT l_orderkey, l_quantity FROM lineitem "
+            "GROUP BY l_orderkey")
+    with pytest.raises(QueryError):
+        runner.execute("SELECT * FROM nosuchtable")
+
+
+def test_show_statements(runner):
+    assert ["lineitem"] in runner.execute("SHOW TABLES").rows
+    cats = runner.execute("SHOW CATALOGS").rows
+    assert ["tpch"] in cats and ["memory"] in cats
+    cols = runner.execute("SHOW COLUMNS FROM lineitem").rows
+    assert any(c[0] == "l_orderkey" and c[1] == "bigint" for c in cols)
+
+
+def test_memory_connector_dml(runner):
+    runner.execute("CREATE TABLE memory.default.t1 (a bigint, b varchar)")
+    r = runner.execute(
+        "INSERT INTO memory.default.t1 VALUES (1, 'x'), (2, 'y')")
+    assert r.update_count == 2
+    res = runner.execute(
+        "SELECT a, b FROM memory.default.t1 ORDER BY a")
+    assert res.rows == [[1, "x"], [2, "y"]]
+    runner.execute("CREATE TABLE memory.default.t2 AS "
+                   "SELECT a * 10 AS a10 FROM memory.default.t1")
+    res = runner.execute("SELECT sum(a10) FROM memory.default.t2")
+    assert res.rows[0][0] == 30
+    runner.execute("DROP TABLE memory.default.t1")
+    with pytest.raises(QueryError):
+        runner.execute("SELECT * FROM memory.default.t1")
+
+
+def test_window_row_number(runner):
+    res = runner.execute("""
+        SELECT x, row_number() OVER (PARTITION BY g ORDER BY x) AS rn
+        FROM (VALUES ('a', 1), ('a', 3), ('a', 2), ('b', 5)) AS t(g, x)
+        ORDER BY g, x
+    """)
+    assert res.rows == [["a" if False else 1, 1], [2, 2], [3, 3], [5, 1]] \
+        or [r[1] for r in res.rows] == [1, 2, 3, 1]
+
+
+def test_explain(runner):
+    res = runner.execute(
+        "EXPLAIN SELECT count(*) FROM lineitem WHERE l_quantity > 10")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "TableScan" in text and "Aggregation" in text

@@ -1,0 +1,135 @@
+"""Connector SPI + catalog management.
+
+Reference parity: core/trino-spi/src/main/java/io/trino/spi/connector/
+(Connector, ConnectorMetadata, ConnectorSplitManager, ConnectorPageSource —
+spi/connector/ConnectorPageSource.java:47) and the engine-side
+metadata/CatalogManager.java + MetadataManager.java routing. TPU-first
+redesign: a connector's read path produces columnar ``Batch``es per split
+(host numpy, uploaded to HBM lazily), not row cursors.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .columnar import Batch
+from .types import Type
+
+
+@dataclass(frozen=True)
+class ColumnMetadata:
+    """spi/connector/ColumnMetadata.java"""
+    name: str
+    type: Type
+
+
+@dataclass(frozen=True)
+class TableMetadata:
+    """spi/connector/ConnectorTableMetadata.java"""
+    schema: str
+    name: str
+    columns: Tuple[ColumnMetadata, ...]
+
+    def column_type(self, name: str) -> Type:
+        for c in self.columns:
+            if c.name == name:
+                return c.type
+        raise KeyError(name)
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c.name for c in self.columns]
+
+
+@dataclass(frozen=True)
+class TableHandle:
+    """Engine-side handle: catalog + connector's table identity
+    (reference: metadata/TableHandle.java wrapping
+    ConnectorTableHandle)."""
+    catalog: str
+    schema: str
+    table: str
+
+
+@dataclass(frozen=True)
+class Split:
+    """One unit of scan parallelism (spi/connector/ConnectorSplit.java).
+    ``part``/``part_count`` mirror the tpch connector's split addressing
+    (plugin/trino-tpch/.../TpchSplitManager.java:32-46)."""
+    handle: TableHandle
+    part: int
+    part_count: int
+
+
+class Connector:
+    """Connector SPI (spi/connector/Connector.java + ConnectorMetadata +
+    ConnectorSplitManager + page source in one surface — the engine is in
+    one process per node, so the factory indirection is unnecessary)."""
+
+    name: str = "connector"
+
+    # --- metadata --------------------------------------------------------
+    def list_schemas(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_tables(self, schema: str) -> List[str]:
+        raise NotImplementedError
+
+    def get_table_metadata(self, schema: str,
+                           table: str) -> Optional[TableMetadata]:
+        raise NotImplementedError
+
+    # --- splits ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_parallelism: int = 1) -> List[Split]:
+        return [Split(handle, 0, 1)]
+
+    # --- data in ---------------------------------------------------------
+    def read_split(self, split: Split,
+                   columns: Sequence[str]) -> Batch:
+        """Produce the split's rows for the requested columns
+        (spi/connector/ConnectorPageSource.java:47 getNextPage, batched)."""
+        raise NotImplementedError
+
+    # --- statistics (spi/statistics/TableStatistics.java) ----------------
+    def table_row_count(self, handle: TableHandle) -> Optional[float]:
+        return None
+
+    # --- data out (spi/connector/ConnectorPageSink.java) -----------------
+    def create_table(self, metadata: TableMetadata) -> None:
+        raise NotImplementedError(f"{self.name}: CREATE TABLE not supported")
+
+    def drop_table(self, schema: str, table: str) -> None:
+        raise NotImplementedError(f"{self.name}: DROP TABLE not supported")
+
+    def insert(self, schema: str, table: str, batch: Batch) -> int:
+        raise NotImplementedError(f"{self.name}: INSERT not supported")
+
+
+class CatalogManager:
+    """metadata/CatalogManager.java — name → Connector registry."""
+
+    def __init__(self):
+        self._catalogs: Dict[str, Connector] = {}
+
+    def register(self, name: str, connector: Connector) -> None:
+        self._catalogs[name] = connector
+
+    def connector(self, name: str) -> Connector:
+        try:
+            return self._catalogs[name]
+        except KeyError:
+            raise KeyError(f"Catalog '{name}' does not exist") from None
+
+    def list_catalogs(self) -> List[str]:
+        return sorted(self._catalogs)
+
+    def resolve_table(self, catalog: str, schema: str,
+                      table: str) -> Tuple[TableHandle, TableMetadata]:
+        conn = self.connector(catalog)
+        meta = conn.get_table_metadata(schema, table)
+        if meta is None:
+            raise KeyError(
+                f"Table '{catalog}.{schema}.{table}' does not exist")
+        return TableHandle(catalog, schema, table), meta

@@ -1,0 +1,2 @@
+"""Connector implementations (reference: plugin/* — 45 modules; here the
+engine-critical set: tpch generator, memory, blackhole, system)."""

@@ -1,0 +1,103 @@
+"""In-memory connector: CREATE TABLE / INSERT target and test stand-in.
+
+Reference parity: plugin/trino-memory (MemoryConnector, MemoryMetadata,
+MemoryPagesStore — 3.3k loc). Stores appended Batches per table; reads
+concatenate them (host-resident; upload to HBM happens lazily at first
+kernel touch like every Batch).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..catalog import (ColumnMetadata, Connector, Split, TableHandle,
+                       TableMetadata)
+from ..columnar import Batch, concat_batches, empty_batch
+
+
+class MemoryConnector(Connector):
+    name = "memory"
+
+    def __init__(self):
+        # (schema, table) -> (metadata, [Batch])
+        self._tables: Dict[Tuple[str, str],
+                           Tuple[TableMetadata, List[Batch]]] = {}
+        self._schemas = {"default"}
+
+    def list_schemas(self) -> List[str]:
+        return sorted(self._schemas)
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(t for (s, t) in self._tables if s == schema)
+
+    def get_table_metadata(self, schema, table) -> Optional[TableMetadata]:
+        entry = self._tables.get((schema, table))
+        return entry[0] if entry else None
+
+    def create_schema(self, schema: str) -> None:
+        self._schemas.add(schema)
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        key = (metadata.schema, metadata.name)
+        if key in self._tables:
+            raise ValueError(
+                f"Table '{metadata.schema}.{metadata.name}' already exists")
+        self._schemas.add(metadata.schema)
+        self._tables[key] = (metadata, [])
+
+    def drop_table(self, schema: str, table: str) -> None:
+        self._tables.pop((schema, table), None)
+
+    def insert(self, schema: str, table: str, batch: Batch) -> int:
+        meta, batches = self._tables[(schema, table)]
+        batch = batch.rename(dict(zip(batch.names, meta.column_names)))
+        batches.append(batch)
+        return batch.num_rows_host()
+
+    def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
+        meta, batches = self._tables[(split.handle.schema,
+                                      split.handle.table)]
+        if not batches:
+            return empty_batch(
+                {c.name: c.type for c in meta.columns
+                 if c.name in set(columns)})
+        whole = concat_batches(batches)
+        return whole.select_columns(list(columns))
+
+    def table_row_count(self, handle: TableHandle) -> Optional[float]:
+        entry = self._tables.get((handle.schema, handle.table))
+        if entry is None:
+            return None
+        return float(sum(b.num_rows_host() for b in entry[1]))
+
+
+class BlackholeConnector(Connector):
+    """plugin/trino-blackhole — instant-discard sink for write benchmarks."""
+
+    name = "blackhole"
+
+    def __init__(self):
+        self._tables: Dict[Tuple[str, str], TableMetadata] = {}
+
+    def list_schemas(self) -> List[str]:
+        return ["default"]
+
+    def list_tables(self, schema: str) -> List[str]:
+        return sorted(t for (s, t) in self._tables if s == schema)
+
+    def get_table_metadata(self, schema, table) -> Optional[TableMetadata]:
+        return self._tables.get((schema, table))
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        self._tables[(metadata.schema, metadata.name)] = metadata
+
+    def drop_table(self, schema: str, table: str) -> None:
+        self._tables.pop((schema, table), None)
+
+    def insert(self, schema: str, table: str, batch: Batch) -> int:
+        return batch.num_rows_host()
+
+    def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
+        meta = self._tables[(split.handle.schema, split.handle.table)]
+        return empty_batch({c.name: c.type for c in meta.columns
+                            if c.name in set(columns)})

@@ -1,0 +1,639 @@
+"""Single-process plan executor.
+
+Reference parity: the worker execution stack — LocalExecutionPlanner
+(sql/planner/LocalExecutionPlanner.java:307) + Driver loop
+(operator/Driver.java:355-440) + the operator set (SURVEY.md §2.1).
+TPU-first redesign (SURVEY.md §7.2): there is no operator pull-loop; the
+executor walks the plan bottom-up, evaluating each node as whole-column
+jnp transformations over capacity-padded Batches. XLA fuses chains of
+filter/project/aggregate into single device programs; data-dependent
+cardinalities (filter/join output sizes) are the only host syncs — the
+two-phase "count, pick bucket, expand" pattern of ops/join.py.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace as dc_replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..catalog import CatalogManager
+from ..columnar import (Batch, Column, StringDictionary, batch_from_pylist,
+                        empty_batch, pad_batch)
+from ..config import capacity_for
+from ..ops import compact, join as join_ops, sort as sort_ops
+from ..ops.groupby import AggInput, global_aggregate, group_aggregate
+from ..ops.hashing import hash_columns, partition_of
+from ..plan.nodes import (AggregationNode, Aggregate, AssignUniqueIdNode,
+                          EnforceSingleRowNode, ExchangeNode, FilterNode,
+                          JoinNode, LimitNode, MarkDistinctNode, OffsetNode,
+                          OutputNode, PlanNode, ProjectNode, SampleNode,
+                          SemiJoinNode, SetOpNode, SortNode, TableScanNode,
+                          TopNNode, UnionNode, ValuesNode, WindowNode)
+from ..planner.logical import SemiJoinMultiNode
+from ..rex import Const, InputRef
+from ..session import Session
+from ..types import (BIGINT, BOOLEAN, DOUBLE, REAL, DecimalType, Type,
+                     is_integral, is_string)
+from .expr import EvalError, eval_expr, eval_predicate
+
+
+class QueryError(Exception):
+    pass
+
+
+@dataclass
+class NodeStats:
+    """OperatorStats analog (operator/OperatorStats.java): wall time and
+    row counts per plan node, powering EXPLAIN ANALYZE."""
+    name: str
+    detail: str = ""
+    wall_s: float = 0.0
+    output_rows: int = -1
+
+
+class Executor:
+    def __init__(self, catalogs: CatalogManager, session: Session,
+                 collect_stats: bool = False):
+        self.catalogs = catalogs
+        self.session = session
+        self.collect_stats = collect_stats
+        self.stats: List[NodeStats] = []
+
+    # ------------------------------------------------------------------
+    def execute(self, node: PlanNode) -> Batch:
+        t0 = time.perf_counter() if self.collect_stats else 0.0
+        method = getattr(self, "_exec_" + type(node).__name__, None)
+        if method is None:
+            raise QueryError(
+                f"no executor for plan node {type(node).__name__}")
+        try:
+            out = method(node)
+        except EvalError as e:
+            raise QueryError(str(e)) from e
+        if self.collect_stats:
+            # blocking read for accurate per-node timing
+            n = out.num_rows_host()
+            self.stats.append(NodeStats(
+                type(node).__name__.replace("Node", ""),
+                wall_s=time.perf_counter() - t0, output_rows=n))
+        return out
+
+    # ------------------------------------------------------------------
+    # leaves
+    # ------------------------------------------------------------------
+    def _exec_TableScanNode(self, node: TableScanNode) -> Batch:
+        conn = self.catalogs.connector(node.handle.catalog)
+        columns = sorted(set(node.assignments.values()))
+        par = int(self.session.get("task_concurrency")) or 1
+        splits = conn.get_splits(node.handle, par)
+        batches = [conn.read_split(s, columns) for s in splits]
+        whole = device_concat(batches) if len(batches) > 1 else batches[0]
+        cols = {sym: whole.column(col)
+                for sym, col in node.assignments.items()}
+        return Batch(cols, whole.num_rows)
+
+    def _exec_ValuesNode(self, node: ValuesNode) -> Batch:
+        data = {s: [row[i] for row in node.rows]
+                for i, s in enumerate(node.schema)}
+        return batch_from_pylist(data, dict(node.schema))
+
+    # ------------------------------------------------------------------
+    # row transforms
+    # ------------------------------------------------------------------
+    def _exec_FilterNode(self, node: FilterNode) -> Batch:
+        src = self.execute(node.source)
+        mask = eval_predicate(node.predicate, src)
+        return compact.filter_batch(src, mask)
+
+    def _exec_ProjectNode(self, node: ProjectNode) -> Batch:
+        src = self.execute(node.source)
+        cols = {s: eval_expr(e, src)
+                for s, e in node.assignments.items()}
+        return Batch(cols, src.num_rows)
+
+    def _exec_OutputNode(self, node: OutputNode) -> Batch:
+        src = self.execute(node.source)
+        return Batch({s: src.column(s) for s in node.symbols},
+                     src.num_rows)
+
+    def _exec_LimitNode(self, node: LimitNode) -> Batch:
+        return compact.limit_batch(self.execute(node.source), node.count)
+
+    def _exec_OffsetNode(self, node: OffsetNode) -> Batch:
+        return compact.offset_batch(self.execute(node.source), node.count)
+
+    def _exec_SortNode(self, node: SortNode) -> Batch:
+        src = self.execute(node.source)
+        keys = [sort_ops.SortKey(k.symbol, k.ascending, k.nulls_first)
+                for k in node.keys]
+        return sort_ops.sort_batch(src, keys)
+
+    def _exec_TopNNode(self, node: TopNNode) -> Batch:
+        src = self.execute(node.source)
+        keys = [sort_ops.SortKey(k.symbol, k.ascending, k.nulls_first)
+                for k in node.keys]
+        return sort_ops.topn_batch(src, keys, node.count)
+
+    def _exec_SampleNode(self, node: SampleNode) -> Batch:
+        src = self.execute(node.source)
+        from ..ops.hashing import mix64
+        h = mix64(jnp.arange(src.capacity, dtype=jnp.uint64))
+        u = (h >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
+        return compact.filter_batch(src, u < node.ratio)
+
+    def _exec_AssignUniqueIdNode(self, node: AssignUniqueIdNode) -> Batch:
+        src = self.execute(node.source)
+        cols = dict(src.columns)
+        cols[node.symbol] = Column(
+            BIGINT, jnp.arange(src.capacity, dtype=jnp.int64), None)
+        return Batch(cols, src.num_rows)
+
+    def _exec_EnforceSingleRowNode(self, node) -> Batch:
+        src = self.execute(node.source)
+        n = src.num_rows_host()
+        if n > 1:
+            raise QueryError(
+                "Scalar sub-query has returned multiple rows")
+        if n == 0:
+            # one all-NULL row
+            cols = {}
+            for s, c in src.columns.items():
+                cols[s] = dc_replace(
+                    c, valid=jnp.zeros((c.capacity,), bool))
+            return Batch(cols, 1)
+        return src
+
+    # ------------------------------------------------------------------
+    # aggregation
+    # ------------------------------------------------------------------
+    def _exec_AggregationNode(self, node: AggregationNode) -> Batch:
+        src = self.execute(node.source)
+        phys, post, extra_cols = _lower_aggregates(node.aggregates, src)
+        if extra_cols:
+            cols = dict(src.columns)
+            cols.update(extra_cols)
+            src = Batch(cols, src.num_rows)
+        if node.group_keys:
+            out = group_aggregate(src, list(node.group_keys), phys)
+        else:
+            out = global_aggregate(src, phys) if phys else \
+                _single_row(src)
+        if post:
+            cols = dict(out.columns)
+            for sym, fn in post.items():
+                cols[sym] = fn(out)
+            # drop intermediate lanes
+            keep = set(node.group_keys) | set(node.aggregates)
+            cols = {s: c for s, c in cols.items() if s in keep}
+            out = Batch(cols, out.num_rows)
+        return out
+
+    def _exec_MarkDistinctNode(self, node: MarkDistinctNode) -> Batch:
+        src = self.execute(node.source)
+        from ..ops.groupby import _key_lanes
+        lanes = _key_lanes(src, list(node.keys))
+        order = jnp.lexsort(lanes[::-1])
+        live_s = jnp.take(src.row_valid(), order)
+        changed = jnp.zeros((src.capacity,), dtype=bool)
+        for lane in lanes[1:]:
+            s = jnp.take(lane, order)
+            changed = changed | (s != jnp.roll(s, 1))
+        first = jnp.arange(src.capacity) == 0
+        boundary = (changed | first) & live_s
+        marker = jnp.zeros((src.capacity,), bool).at[order].set(boundary)
+        cols = dict(src.columns)
+        cols[node.marker] = Column(BOOLEAN, marker, None)
+        return Batch(cols, src.num_rows)
+
+    # ------------------------------------------------------------------
+    # joins
+    # ------------------------------------------------------------------
+    def _exec_JoinNode(self, node: JoinNode) -> Batch:
+        jt = node.join_type
+        if jt == "right":
+            flipped = JoinNode(node.right, node.left, "left",
+                               tuple(join_ops and
+                                     _flip_clause(c)
+                                     for c in node.criteria),
+                               node.filter)
+            return self._exec_JoinNode(flipped)
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+
+        if jt == "cross" or not node.criteria:
+            return self._cross_join(left, right, node.filter,
+                                    outer=(jt == "left"))
+
+        pkeys = [c.left for c in node.criteria]
+        bkeys = [c.right for c in node.criteria]
+        if node.filter is None:
+            start, count, order = join_ops.match_counts(
+                left, right, pkeys, bkeys)
+            outer = jt in ("left", "full")
+            live_p = left.row_valid()
+            eff = jnp.where(live_p, jnp.maximum(count, 1), 0) if outer \
+                else count
+            total = int(jnp.sum(eff))
+            cap = capacity_for(total)
+            out = join_ops.expand_join(
+                left, right, start, count, order, cap,
+                "left" if outer else "inner")
+            if jt == "full":
+                out = self._append_right_unmatched(
+                    out, left, right, pkeys, bkeys)
+            return out
+        # residual filter: expand as inner candidates + probe position
+    # track, filter, then repair left-join missing rows
+        ppos = "__probe_pos$"
+        lcols = dict(left.columns)
+        lcols[ppos] = Column(BIGINT,
+                             jnp.arange(left.capacity, dtype=jnp.int64),
+                             None)
+        probe = Batch(lcols, left.num_rows)
+        start, count, order = join_ops.match_counts(
+            probe, right, pkeys, bkeys)
+        total = int(jnp.sum(count))
+        cap = capacity_for(total)
+        cand = join_ops.expand_join(probe, right, start, count, order,
+                                    cap, "inner")
+        mask = eval_predicate(node.filter, cand)
+        out = compact.filter_batch(cand, mask)
+        if jt in ("left", "full"):
+            matched = jnp.zeros((left.capacity,), bool)
+            pp = jnp.asarray(out.column(ppos).data)
+            live_out = out.row_valid()
+            matched = matched.at[jnp.where(live_out, pp, 0)].max(
+                live_out)
+            unmatched = left.row_valid() & ~matched
+            pad = self._null_extend(left, right, unmatched)
+            out = Batch({s: c for s, c in out.columns.items()
+                         if s != ppos}, out.num_rows)
+            out = device_concat([out, pad])
+        else:
+            out = Batch({s: c for s, c in out.columns.items()
+                         if s != ppos}, out.num_rows)
+        if jt == "full":
+            out = self._append_right_unmatched(out, left, right,
+                                               pkeys, bkeys)
+        return out
+
+    def _cross_join(self, left: Batch, right: Batch, filt,
+                    outer: bool = False) -> Batch:
+        nl, nr = left.num_rows_host(), right.num_rows_host()
+        total = nl * nr
+        cap = capacity_for(max(total, 1))
+        start, count, order = join_ops.cross_counts(left, right)
+        out = join_ops.expand_join(left, right, start, count, order, cap,
+                                   "inner")
+        if filt is not None:
+            mask = eval_predicate(filt, out)
+            out = compact.filter_batch(out, mask)
+        return out
+
+    def _null_extend(self, left: Batch, right: Batch,
+                     row_mask) -> Batch:
+        """Rows of ``left`` where mask, with all-NULL right columns."""
+        sub = compact.filter_batch(left, row_mask)
+        cols = dict(sub.columns)
+        for s, c in right.columns.items():
+            z = jnp.zeros((sub.capacity,), dtype=np.asarray(c.data).dtype)
+            cols[s] = Column(c.type, z,
+                             jnp.zeros((sub.capacity,), bool),
+                             c.dictionary,
+                             None if c.data2 is None else
+                             jnp.zeros((sub.capacity,), jnp.int64))
+        return Batch(cols, sub.num_rows)
+
+    def _append_right_unmatched(self, out: Batch, left: Batch,
+                                right: Batch, pkeys, bkeys) -> Batch:
+        # FULL JOIN tail: right rows with no probe match, null-extended
+        start, count, order = join_ops.match_counts(
+            right, left, bkeys, pkeys)
+        unmatched = right.row_valid() & (count == 0)
+        sub = compact.filter_batch(right, unmatched)
+        cols = {}
+        for s, c in left.columns.items():
+            z = jnp.zeros((sub.capacity,), dtype=np.asarray(c.data).dtype)
+            cols[s] = Column(c.type, z, jnp.zeros((sub.capacity,), bool),
+                             c.dictionary)
+        cols.update(sub.columns)
+        pad = Batch(cols, sub.num_rows)
+        return device_concat([out, pad])
+
+    def _exec_SemiJoinNode(self, node: SemiJoinNode) -> Batch:
+        src = self.execute(node.source)
+        filt = self.execute(node.filtering_source)
+        matched, key_null, build_null, nonempty = join_ops.semi_join_mask(
+            src, filt, [node.source_key], [node.filtering_key])
+        # x IN (...): TRUE if matched; FALSE if build empty; NULL if the
+        # probe key is NULL or the build side contains NULLs; else FALSE
+        data = matched
+        valid = matched | ~nonempty | (~key_null & ~build_null)
+        cols = dict(src.columns)
+        cols[node.output] = Column(BOOLEAN, data, valid)
+        return Batch(cols, src.num_rows)
+
+    def _exec_SemiJoinMultiNode(self, node: SemiJoinMultiNode) -> Batch:
+        src = self.execute(node.source)
+        filt = self.execute(node.filtering_source)
+        skeys = list(node.source_keys)
+        fkeys = list(node.filtering_keys)
+        if node.filter is None and skeys:
+            matched, _, _, _ = join_ops.semi_join_mask(
+                src, filt, skeys, fkeys)
+            cols = dict(src.columns)
+            cols[node.output] = Column(BOOLEAN, matched, None)
+            return Batch(cols, src.num_rows)
+        # residual filter path: expand candidate matches, filter, then
+        # mark probe rows with surviving matches
+        ppos = "__probe_pos$"
+        scols = dict(src.columns)
+        scols[ppos] = Column(BIGINT,
+                             jnp.arange(src.capacity, dtype=jnp.int64),
+                             None)
+        probe = Batch(scols, src.num_rows)
+        if skeys:
+            start, count, order = join_ops.match_counts(
+                probe, filt, skeys, fkeys)
+        else:
+            start, count, order = join_ops.cross_counts(probe, filt)
+        total = int(jnp.sum(count))
+        cap = capacity_for(total)
+        cand = join_ops.expand_join(probe, filt, start, count, order,
+                                    cap, "inner")
+        if node.filter is not None:
+            mask = eval_predicate(node.filter, cand)
+        else:
+            mask = cand.row_valid()
+        pp = jnp.asarray(cand.column(ppos).data)
+        live = cand.row_valid() & mask
+        matched = jnp.zeros((src.capacity,), bool).at[
+            jnp.where(live, pp, 0)].max(live)
+        cols = dict(src.columns)
+        cols[node.output] = Column(BOOLEAN, matched, None)
+        return Batch(cols, src.num_rows)
+
+    # ------------------------------------------------------------------
+    # set operations
+    # ------------------------------------------------------------------
+    def _exec_UnionNode(self, node: UnionNode) -> Batch:
+        parts = []
+        for child, smap in zip(node.children, node.symbol_maps):
+            b = self.execute(child)
+            parts.append(Batch(
+                {out: b.column(inner) for out, inner in smap.items()},
+                b.num_rows))
+        return device_concat(parts)
+
+    def _exec_SetOpNode(self, node: SetOpNode) -> Batch:
+        left = self.execute(node.left)
+        right = self.execute(node.right)
+        out_syms = list(node.schema)
+        lb = Batch({o: left.column(i) for o, i in node.left_map.items()},
+                   left.num_rows)
+        rb = Batch({o: right.column(i)
+                    for o, i in node.right_map.items()}, right.num_rows)
+        # tag sides, group by all columns, filter on per-side counts
+        # (reference rules: ImplementIntersectDistinctAsUnion etc.)
+        tagged = []
+        for b, (lc, rc) in ((lb, (1, 0)), (rb, (0, 1))):
+            cols = dict(b.columns)
+            cols["__l$"] = Column(
+                BIGINT, jnp.full((b.capacity,), lc, jnp.int64), None)
+            cols["__r$"] = Column(
+                BIGINT, jnp.full((b.capacity,), rc, jnp.int64), None)
+            tagged.append(Batch(cols, b.num_rows))
+        both = device_concat(tagged)
+        aggs = [AggInput("sum", "__l$", output="__nl$"),
+                AggInput("sum", "__r$", output="__nr$")]
+        g = group_aggregate(both, out_syms, aggs)
+        nl = jnp.asarray(g.column("__nl$").data)
+        nr = jnp.asarray(g.column("__nr$").data)
+        if node.op == "intersect":
+            keep = (nl > 0) & (nr > 0)
+        else:
+            keep = (nl > 0) & (nr == 0)
+        out = compact.filter_batch(g, keep)
+        if not node.distinct:
+            # ALL semantics: replicate each row min/max-difference times
+            times = (jnp.minimum(nl, nr) if node.op == "intersect"
+                     else jnp.maximum(nl - nr, 0))
+            times = jnp.take(times,
+                             compact.mask_to_gather(keep)[0])
+            total = int(jnp.sum(jnp.where(out.row_valid(), times, 0)))
+            cap = capacity_for(max(total, 1))
+            incl = jnp.cumsum(jnp.where(out.row_valid(), times, 0))
+            offs = incl - times
+            i = jnp.arange(cap, dtype=jnp.int64)
+            p = jnp.searchsorted(incl, i, side="right")
+            p = jnp.clip(p, 0, out.capacity - 1)
+            out = out.gather(p, total)
+        return Batch({s: out.column(s) for s in out_syms}, out.num_rows)
+
+    # ------------------------------------------------------------------
+    # windows
+    # ------------------------------------------------------------------
+    def _exec_WindowNode(self, node: WindowNode) -> Batch:
+        from .window import execute_window
+        src = self.execute(node.source)
+        return execute_window(src, node)
+
+    # ------------------------------------------------------------------
+    def _exec_ExchangeNode(self, node: ExchangeNode) -> Batch:
+        # single-process execution: exchanges are identity (M3 replaces
+        # this with all_to_all / all_gather over the device mesh)
+        return self.execute(node.source)
+
+    def _single_row(self, src: Batch) -> Batch:
+        return _single_row(src)
+
+
+def _flip_clause(c):
+    from ..plan.nodes import JoinClause
+    return JoinClause(c.right, c.left)
+
+
+def _single_row(src: Batch) -> Batch:
+    return Batch({"__one$": Column(
+        BIGINT, jnp.zeros((8,), jnp.int64), None)}, 1)
+
+
+# --------------------------------------------------------------------------
+# aggregate lowering (avg & friends -> segment-op primitives)
+# --------------------------------------------------------------------------
+
+def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
+    """Map logical aggregates onto the kernel-supported kinds
+    (sum/count/count_star/min/max/any_value), returning
+    (phys_aggs, post_fns, extra_columns). The decomposition mirrors the
+    reference's accumulator states (e.g. avg = LongAndDoubleState,
+    variance = CentralMomentsState —
+    operator/aggregation/AverageAggregations.java, CentralMomentsState)."""
+    phys: List[AggInput] = []
+    post = {}
+    extra: Dict[str, Column] = {}
+
+    for sym, a in aggregates.items():
+        kind = a.kind
+        if kind in ("sum", "min", "max", "count", "count_star"):
+            phys.append(AggInput(kind, a.argument, a.mask, sym))
+        elif kind in ("any_value", "arbitrary"):
+            phys.append(AggInput("any_value", a.argument, a.mask, sym))
+        elif kind == "avg":
+            ssym, csym = sym + "$sum", sym + "$cnt"
+            phys.append(AggInput("sum", a.argument, a.mask, ssym))
+            phys.append(AggInput("count", a.argument, a.mask, csym))
+            post[sym] = _avg_post(ssym, csym, a.type)
+        elif kind == "count_if":
+            msym = sym + "$mask"
+            arg = src.column(a.argument)
+            m = jnp.asarray(arg.data).astype(bool)
+            if arg.valid is not None:
+                m = m & jnp.asarray(arg.valid)
+            if a.mask is not None:
+                mc = src.column(a.mask)
+                mm = jnp.asarray(mc.data).astype(bool)
+                if mc.valid is not None:
+                    mm = mm & jnp.asarray(mc.valid)
+                m = m & mm
+            extra[msym] = Column(BOOLEAN, m, None)
+            phys.append(AggInput("count_star", None, msym, sym))
+        elif kind in ("bool_and", "every", "bool_or"):
+            op = "min" if kind in ("bool_and", "every") else "max"
+            phys.append(AggInput(op, a.argument, a.mask, sym))
+        elif kind in ("stddev", "stddev_samp", "stddev_pop", "variance",
+                      "var_samp", "var_pop"):
+            arg = src.column(a.argument)
+            sqsym = sym + "$sq"
+            d = jnp.asarray(arg.data).astype(jnp.float64)
+            extra[sqsym] = Column(DOUBLE, d * d, arg.valid)
+            ssym, csym, s2sym = sym + "$s", sym + "$c", sym + "$s2"
+            phys.append(AggInput("sum", a.argument, a.mask, ssym))
+            phys.append(AggInput("count", a.argument, a.mask, csym))
+            phys.append(AggInput("sum", sqsym, a.mask, s2sym))
+            pop = kind.endswith("_pop")
+            sqrt = kind.startswith("stddev")
+            post[sym] = _variance_post(ssym, csym, s2sym, pop, sqrt)
+        elif kind == "geometric_mean":
+            arg = src.column(a.argument)
+            lsym = sym + "$ln"
+            d = jnp.asarray(arg.data).astype(jnp.float64)
+            extra[lsym] = Column(DOUBLE, jnp.log(d), arg.valid)
+            ssym, csym = sym + "$s", sym + "$c"
+            phys.append(AggInput("sum", lsym, a.mask, ssym))
+            phys.append(AggInput("count", lsym, a.mask, csym))
+            post[sym] = _geomean_post(ssym, csym)
+        else:
+            raise QueryError(f"aggregate '{kind}' not implemented")
+    return phys, post, extra
+
+
+def _avg_post(ssym, csym, rtype):
+    def fn(out: Batch) -> Column:
+        s = out.column(ssym)
+        c = out.column(csym)
+        cnt = jnp.asarray(c.data).astype(jnp.float64)
+        num = jnp.asarray(s.data).astype(jnp.float64)
+        if isinstance(s.type, DecimalType):
+            num = num / (10.0 ** s.type.scale)
+        data = num / jnp.maximum(cnt, 1.0)
+        valid = cnt > 0
+        if isinstance(rtype, DecimalType):
+            q = (jnp.sign(data) *
+                 jnp.floor(jnp.abs(data) * 10.0 ** rtype.scale + 0.5))
+            return Column(rtype, q.astype(jnp.int64), valid)
+        if rtype is REAL:
+            return Column(rtype, data.astype(jnp.float32), valid)
+        return Column(rtype, data, valid)
+    return fn
+
+
+def _variance_post(ssym, csym, s2sym, pop: bool, sqrt: bool):
+    def fn(out: Batch) -> Column:
+        s = jnp.asarray(out.column(ssym).data).astype(jnp.float64)
+        n = jnp.asarray(out.column(csym).data).astype(jnp.float64)
+        s2 = jnp.asarray(out.column(s2sym).data).astype(jnp.float64)
+        m2 = s2 - s * s / jnp.maximum(n, 1.0)
+        denom = jnp.maximum(n if pop else n - 1.0, 1.0)
+        v = m2 / denom
+        v = jnp.maximum(v, 0.0)
+        data = jnp.sqrt(v) if sqrt else v
+        valid = n > (0.0 if pop else 1.0)
+        return Column(DOUBLE, data, valid)
+    return fn
+
+
+def _geomean_post(ssym, csym):
+    def fn(out: Batch) -> Column:
+        s = jnp.asarray(out.column(ssym).data).astype(jnp.float64)
+        n = jnp.asarray(out.column(csym).data).astype(jnp.float64)
+        return Column(DOUBLE, jnp.exp(s / jnp.maximum(n, 1.0)), n > 0)
+    return fn
+
+
+# --------------------------------------------------------------------------
+# device concat (local exchange merge)
+# --------------------------------------------------------------------------
+
+def device_concat(parts: Sequence[Batch]) -> Batch:
+    """Concatenate live prefixes of Batches on device.
+
+    The gather indices are host-computed from (host) row counts — this is
+    the local-exchange merge point (reference: operator/exchange/
+    LocalExchange.java), a natural host sync."""
+    parts = list(parts)
+    if len(parts) == 1:
+        return parts[0]
+    counts = [p.num_rows_host() for p in parts]
+    total = sum(counts)
+    cap = capacity_for(max(total, 1))
+    names = parts[0].names
+    out_cols: Dict[str, Column] = {}
+    for name in names:
+        cols = [p.column(name) for p in parts]
+        typ = cols[0].type
+        if is_string(typ):
+            merged = cols[0].dictionary
+            remaps = [np.arange(len(merged), dtype=np.int32)]
+            for c in cols[1:]:
+                merged, _, ro = merged.merge(c.dictionary)
+                remaps.append(ro)
+            lanes = [jnp.take(jnp.asarray(rm),
+                              jnp.asarray(c.data).astype(jnp.int32),
+                              mode="clip")
+                     for c, rm in zip(cols, remaps)]
+        else:
+            dt = np.asarray(cols[0].data).dtype
+            lanes = [jnp.asarray(c.data).astype(dt) for c in cols]
+        glued = jnp.concatenate(lanes)
+        # host-computed index of each part's live prefix
+        idx_parts = []
+        offset = 0
+        for c, n in zip(cols, counts):
+            idx_parts.append(np.arange(n, dtype=np.int64) + offset)
+            offset += c.capacity
+        idx = np.concatenate(idx_parts) if idx_parts else \
+            np.zeros(0, np.int64)
+        idx = np.pad(idx, (0, cap - len(idx)))
+        data = jnp.take(glued, jnp.asarray(idx), mode="clip")
+        any_valid = any(c.valid is not None for c in cols)
+        valid = None
+        if any_valid:
+            vlanes = [jnp.ones((c.capacity,), bool) if c.valid is None
+                      else jnp.asarray(c.valid) for c in cols]
+            valid = jnp.take(jnp.concatenate(vlanes), jnp.asarray(idx),
+                             mode="clip")
+        d2 = None
+        if any(c.data2 is not None for c in cols):
+            l2 = [jnp.zeros((c.capacity,), jnp.int64) if c.data2 is None
+                  else jnp.asarray(c.data2) for c in cols]
+            d2 = jnp.take(jnp.concatenate(l2), jnp.asarray(idx),
+                          mode="clip")
+        out_cols[name] = Column(typ, data, valid,
+                                merged if is_string(typ) else None, d2)
+    return Batch(out_cols, total)

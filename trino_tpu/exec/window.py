@@ -1,0 +1,253 @@
+"""Window function execution.
+
+Reference parity: operator/WindowOperator.java + operator/window/ (21
+files: FrameInfo, WindowPartition, rank/value functions — SURVEY.md
+Appendix A.6). TPU redesign: one lexsort by (partition, order) keys, then
+every function is segment arithmetic over the sorted order — partition
+boundaries from key-change detection, ranks from order-key-change
+cumsums, running aggregates from cumsum minus the partition-start prefix.
+Results scatter back to input row order, so WindowNode preserves row
+positions (like the reference's PagesIndex approach).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import Batch, Column
+from ..ops import sort as sort_ops
+from ..ops.groupby import _key_lanes
+from ..plan.nodes import SortKey, WindowFunction, WindowNode
+from ..types import BIGINT, DOUBLE, DecimalType, REAL
+
+
+def execute_window(src: Batch, node: WindowNode) -> Batch:
+    cap = src.capacity
+    live = src.row_valid()
+
+    skeys = [sort_ops.SortKey(s, True, False) for s in node.partition_by]
+    skeys += [sort_ops.SortKey(k.symbol, k.ascending, k.nulls_first)
+              for k in node.order_by]
+    order = (sort_ops.sort_order(src, skeys) if skeys
+             else jnp.arange(cap, dtype=jnp.int64))
+    live_s = jnp.take(live, order)
+    pos = jnp.arange(cap, dtype=jnp.int64)
+
+    # partition boundaries over sorted order
+    if node.partition_by:
+        plane = _key_lanes(src, list(node.partition_by))
+        p_changed = jnp.zeros((cap,), bool)
+        for lane in plane[1:]:
+            s = jnp.take(lane, order)
+            p_changed = p_changed | (s != jnp.roll(s, 1))
+        p_boundary = (p_changed | (pos == 0)) & live_s
+    else:
+        p_boundary = (pos == 0) & live_s
+    pid = jnp.cumsum(p_boundary.astype(jnp.int64)) - 1
+    pid_c = jnp.clip(pid, 0, cap - 1).astype(jnp.int32)
+    part_start = jax.ops.segment_min(
+        jnp.where(live_s, pos, jnp.int64(cap)), pid_c, num_segments=cap)
+    part_size = jax.ops.segment_sum(live_s.astype(jnp.int64), pid_c,
+                                    num_segments=cap)
+
+    # peer (order-key) boundaries for rank/dense_rank
+    if node.order_by:
+        olane = _key_lanes(src, [k.symbol for k in node.order_by])
+        o_changed = jnp.zeros((cap,), bool)
+        for lane in olane[1:]:
+            s = jnp.take(lane, order)
+            o_changed = o_changed | (s != jnp.roll(s, 1))
+        peer_boundary = (o_changed | p_boundary) & live_s
+    else:
+        peer_boundary = p_boundary
+
+    row_in_part = pos - jnp.take(part_start, pid_c)
+
+    out_cols: Dict[str, Column] = dict(src.columns)
+    for sym, fn in node.functions.items():
+        vals_s = _eval_fn(fn, src, order, live_s, pid_c, pos, part_start,
+                          part_size, peer_boundary, row_in_part, node)
+        data, valid = vals_s
+        # scatter back to input row order
+        inv = jnp.zeros((cap,), jnp.int64).at[order].set(pos)
+        out_data = jnp.take(data, inv)
+        out_valid = None if valid is None else jnp.take(valid, inv)
+        col = Column(fn.type, out_data, out_valid)
+        if fn.argument is not None and fn.kind in ("min", "max",
+                                                   "any_value",
+                                                   "first_value",
+                                                   "last_value", "lag",
+                                                   "lead", "nth_value"):
+            srccol = src.column(fn.argument)
+            if srccol.dictionary is not None:
+                col = Column(fn.type, out_data.astype(jnp.int32),
+                             out_valid, srccol.dictionary)
+        out_cols[sym] = col
+    return Batch(out_cols, src.num_rows)
+
+
+def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
+             part_start, part_size, peer_boundary, row_in_part, node):
+    cap = src.capacity
+    k = fn.kind
+    if k == "row_number":
+        return row_in_part + 1, None
+    if k == "rank":
+        # rank = position of the peer-group start within the partition
+        peer_start = _running_last_where(pos, peer_boundary)
+        return peer_start - jnp.take(part_start, pid) + 1, None
+    if k == "dense_rank":
+        dr = jnp.cumsum(peer_boundary.astype(jnp.int64))
+        part_first_dr = jax.ops.segment_min(
+            jnp.where(live_s, dr, jnp.int64(cap + 1)), pid,
+            num_segments=cap)
+        return dr - jnp.take(part_first_dr, pid) + 1, None
+    if k == "percent_rank":
+        peer_start = _running_last_where(pos, peer_boundary)
+        r = (peer_start - jnp.take(part_start, pid)).astype(jnp.float64)
+        n = jnp.take(part_size, pid).astype(jnp.float64)
+        return jnp.where(n > 1, r / jnp.maximum(n - 1.0, 1.0), 0.0), None
+    if k == "cume_dist":
+        # count of rows <= current peer group end
+        peer_id = jnp.cumsum(peer_boundary.astype(jnp.int64)) - 1
+        peer_id_c = jnp.clip(peer_id, 0, cap - 1).astype(jnp.int32)
+        peer_end = jax.ops.segment_max(
+            jnp.where(live_s, pos, jnp.int64(-1)), peer_id_c,
+            num_segments=cap)
+        ends = jnp.take(peer_end, peer_id_c)
+        n = jnp.take(part_size, pid).astype(jnp.float64)
+        rel = (ends - jnp.take(part_start, pid) + 1).astype(jnp.float64)
+        return rel / jnp.maximum(n, 1.0), None
+    if k == "ntile":
+        n = jnp.take(part_size, pid)
+        buckets = jnp.int64(4)  # argument support TBD
+        return (row_in_part * buckets) // jnp.maximum(n, 1) + 1, None
+
+    # value / aggregate functions need the argument lane in sorted order
+    col = src.column(fn.argument) if fn.argument else None
+    if col is not None:
+        vals = jnp.take(jnp.asarray(col.data), order)
+        valid_lane = (live_s if col.valid is None
+                      else live_s & jnp.take(jnp.asarray(col.valid), order))
+    else:
+        vals = live_s.astype(jnp.int64)
+        valid_lane = live_s
+
+    unbounded_end = (fn.frame_end in ("unbounded_following",)
+                     or not node.order_by)
+
+    if k in ("first_value",):
+        first_pos = jnp.take(part_start, pid)
+        return jnp.take(vals, first_pos), jnp.take(valid_lane, first_pos)
+    if k in ("last_value",):
+        if unbounded_end:
+            last_pos = jnp.take(part_start, pid) + \
+                jnp.take(part_size, pid) - 1
+        else:
+            last_pos = pos  # running frame: current row
+        last_pos = jnp.clip(last_pos, 0, cap - 1)
+        return jnp.take(vals, last_pos), jnp.take(valid_lane, last_pos)
+    if k in ("lag", "lead"):
+        off = 1
+        tgt = pos - off if k == "lag" else pos + off
+        same_part = (tgt >= jnp.take(part_start, pid)) & \
+            (tgt < jnp.take(part_start, pid) + jnp.take(part_size, pid))
+        tgt_c = jnp.clip(tgt, 0, cap - 1)
+        return (jnp.take(vals, tgt_c),
+                jnp.take(valid_lane, tgt_c) & same_part)
+
+    # aggregates over the partition (or running when ordered)
+    masked = jnp.where(valid_lane, vals, 0)
+    if k in ("count", "count_star"):
+        lane = valid_lane.astype(jnp.int64)
+        total = jax.ops.segment_sum(lane, pid, num_segments=cap)
+        if unbounded_end:
+            return jnp.take(total, pid), None
+        run = jnp.cumsum(lane)
+        base = _part_base(run, lane, part_start, pid)
+        return run - base, None
+    if k == "sum":
+        acc = masked.astype(
+            jnp.float64 if vals.dtype in (jnp.float32, jnp.float64)
+            else jnp.int64)
+        nval = jax.ops.segment_sum(valid_lane.astype(jnp.int64), pid,
+                                   num_segments=cap)
+        if unbounded_end:
+            tot = jax.ops.segment_sum(acc, pid, num_segments=cap)
+            return (jnp.take(tot, pid).astype(vals.dtype),
+                    jnp.take(nval, pid) > 0)
+        run = jnp.cumsum(acc)
+        base = _part_base(run, acc, part_start, pid)
+        runv = jnp.cumsum(valid_lane.astype(jnp.int64))
+        vbase = _part_base(runv, valid_lane.astype(jnp.int64),
+                           part_start, pid)
+        return ((run - base).astype(vals.dtype), (runv - vbase) > 0)
+    if k == "avg":
+        acc = masked.astype(jnp.float64)
+        cnt = valid_lane.astype(jnp.int64)
+        if unbounded_end:
+            s = jax.ops.segment_sum(acc, pid, num_segments=cap)
+            n = jax.ops.segment_sum(cnt, pid, num_segments=cap)
+            s, n = jnp.take(s, pid), jnp.take(n, pid)
+        else:
+            rs, rn = jnp.cumsum(acc), jnp.cumsum(cnt)
+            s = rs - _part_base(rs, acc, part_start, pid)
+            n = rn - _part_base(rn, cnt, part_start, pid)
+        return s / jnp.maximum(n.astype(jnp.float64), 1.0), n > 0
+    if k in ("min", "max"):
+        seg = jax.ops.segment_min if k == "min" else jax.ops.segment_max
+        if vals.dtype in (jnp.float32, jnp.float64):
+            ident = jnp.asarray(jnp.inf if k == "min" else -jnp.inf,
+                                vals.dtype)
+        else:
+            info = jnp.iinfo(vals.dtype if vals.dtype != jnp.bool_
+                             else jnp.int32)
+            ident = jnp.asarray(info.max if k == "min" else info.min)
+        w = jnp.where(valid_lane, vals, ident)
+        nval = jax.ops.segment_sum(valid_lane.astype(jnp.int64), pid,
+                                   num_segments=cap)
+        tot = seg(w, pid, num_segments=cap)
+        if unbounded_end:
+            return jnp.take(tot, pid), jnp.take(nval, pid) > 0
+        # running min/max via associative scan within partitions
+        op = jnp.minimum if k == "min" else jnp.maximum
+        run = jax.lax.associative_scan(
+            lambda a, b: op(a, b), jnp.where(peer_boundary | True, w, w))
+        # reset at partition starts: recompute with segmented scan
+        run = _segmented_scan(w, pid, op)
+        runv = jnp.cumsum(valid_lane.astype(jnp.int64))
+        vbase = _part_base(runv, valid_lane.astype(jnp.int64),
+                           part_start, pid)
+        return run, (runv - vbase) > 0
+    raise ValueError(f"window function '{k}' not implemented")
+
+
+def _running_last_where(pos, flag):
+    """For each position, the most recent position where flag was True."""
+    marked = jnp.where(flag, pos, jnp.int64(-1))
+    return jax.lax.associative_scan(jnp.maximum, marked)
+
+
+def _part_base(running, lane, part_start, pid):
+    """Value of the running sum just before each partition start."""
+    start_pos = jnp.take(part_start, pid)
+    start_val = jnp.take(running, jnp.clip(start_pos, 0, len(running) - 1))
+    start_lane = jnp.take(lane, jnp.clip(start_pos, 0, len(lane) - 1))
+    return start_val - start_lane
+
+
+def _segmented_scan(vals, pid, op):
+    """Inclusive segmented scan: restart accumulation at pid changes."""
+    pairs = (vals, pid.astype(jnp.int64))
+
+    def combine(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bi != ai
+        return jnp.where(take_b, bv, op(av, bv)), bi
+
+    out, _ = jax.lax.associative_scan(combine, pairs)
+    return out

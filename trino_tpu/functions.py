@@ -1,0 +1,218 @@
+"""Function registry: name + argument types -> result type.
+
+Reference parity: core/trino-main/.../metadata/FunctionRegistry.java:368
+(~267 builtins) + SignatureBinder overload resolution, collapsed to a
+type-directed table because the TPU engine dispatches execution on
+(name, physical lane dtype) in the evaluator rather than on MethodHandles.
+Implementations live in exec/scalars.py; this module is pure typing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, UNKNOWN,
+                    VARCHAR, DecimalType, TimestampType, Type, VarcharType,
+                    common_super_type, is_exact_numeric, is_integral,
+                    is_numeric, is_string)
+
+# --- aggregates -----------------------------------------------------------
+
+AGGREGATE_NAMES = {
+    "sum", "min", "max", "avg", "count", "count_if", "any_value",
+    "arbitrary", "bool_and", "bool_or", "every", "stddev", "stddev_samp",
+    "stddev_pop", "variance", "var_samp", "var_pop", "geometric_mean",
+    "approx_distinct", "min_by", "max_by", "array_agg", "checksum",
+    "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+    "skewness", "kurtosis",
+}
+
+WINDOW_ONLY_NAMES = {
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+    "ntile", "first_value", "last_value", "nth_value", "lag", "lead",
+}
+
+
+def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
+    """Result type of an aggregate (reference: operator/aggregation/*
+    output types, SURVEY.md Appendix A.7)."""
+    t = arg_types[0] if arg_types else None
+    if name == "count" or name == "count_if" or name == "approx_distinct":
+        return BIGINT
+    if name == "sum":
+        if is_integral(t):
+            return BIGINT
+        if isinstance(t, DecimalType):
+            return DecimalType(38, t.scale)
+        return t
+    if name in ("min", "max", "any_value", "arbitrary"):
+        return t
+    if name in ("min_by", "max_by"):
+        return t
+    if name == "avg":
+        if isinstance(t, DecimalType):
+            return t
+        if t is REAL:
+            return REAL
+        return DOUBLE
+    if name in ("bool_and", "bool_or", "every"):
+        return BOOLEAN
+    if name in ("stddev", "stddev_samp", "stddev_pop", "variance",
+                "var_samp", "var_pop", "geometric_mean", "corr",
+                "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
+                "skewness", "kurtosis"):
+        return DOUBLE
+    if name == "checksum":
+        return BIGINT
+    if name == "array_agg":
+        from .types import ArrayType
+        return ArrayType(t)
+    raise KeyError(f"unknown aggregate: {name}")
+
+
+# --- scalars --------------------------------------------------------------
+
+class FunctionResolutionError(Exception):
+    pass
+
+
+def _numeric_unary(name, args):
+    t = args[0]
+    if not is_numeric(t):
+        raise FunctionResolutionError(f"{name}({t}) not supported")
+    return t
+
+
+def _double_fn(name, args):
+    for t in args:
+        if not is_numeric(t):
+            raise FunctionResolutionError(f"{name}({t}) not supported")
+    return DOUBLE
+
+
+def _common(name, args):
+    out = args[0]
+    for t in args[1:]:
+        nxt = common_super_type(out, t)
+        if nxt is None:
+            raise FunctionResolutionError(
+                f"{name}: incompatible types {out}, {t}")
+        out = nxt
+    return out
+
+
+def _varchar_fn(name, args):
+    return VARCHAR
+
+
+def _bigint_fn(name, args):
+    return BIGINT
+
+
+_SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
+    # math (operator/scalar/MathFunctions.java)
+    "abs": _numeric_unary,
+    "negate": _numeric_unary,
+    "round": lambda n, a: a[0] if not is_string(a[0]) else _err(n, a),
+    "floor": _numeric_unary,
+    "ceil": _numeric_unary,
+    "ceiling": _numeric_unary,
+    "truncate": _numeric_unary,
+    "sqrt": _double_fn, "cbrt": _double_fn, "exp": _double_fn,
+    "ln": _double_fn, "log2": _double_fn, "log10": _double_fn,
+    "power": _double_fn, "pow": _double_fn,
+    "sin": _double_fn, "cos": _double_fn, "tan": _double_fn,
+    "asin": _double_fn, "acos": _double_fn, "atan": _double_fn,
+    "atan2": _double_fn, "sinh": _double_fn, "cosh": _double_fn,
+    "tanh": _double_fn, "degrees": _double_fn, "radians": _double_fn,
+    "sign": _numeric_unary,
+    "mod": _common,
+    "pi": lambda n, a: DOUBLE,
+    "e": lambda n, a: DOUBLE,
+    "random": lambda n, a: DOUBLE,
+    "rand": lambda n, a: DOUBLE,
+    "nan": lambda n, a: DOUBLE,
+    "infinity": lambda n, a: DOUBLE,
+    "is_nan": lambda n, a: BOOLEAN,
+    "is_finite": lambda n, a: BOOLEAN,
+    "is_infinite": lambda n, a: BOOLEAN,
+    "greatest": _common, "least": _common,
+    "width_bucket": _bigint_fn,
+    # conditional (SpecialForm in the reference)
+    "coalesce": _common,
+    "nullif": lambda n, a: a[0],
+    "if": lambda n, a: _common(n, a[1:]),
+    "try": lambda n, a: a[0],
+    # strings (operator/scalar/StringFunctions.java)
+    "length": _bigint_fn,
+    "lower": _varchar_fn, "upper": _varchar_fn,
+    "trim": _varchar_fn, "ltrim": _varchar_fn, "rtrim": _varchar_fn,
+    "reverse": _varchar_fn,
+    "substring": _varchar_fn, "substr": _varchar_fn,
+    "replace": _varchar_fn,
+    "concat": _varchar_fn,
+    "concat_ws": _varchar_fn,
+    "strpos": _bigint_fn,
+    "position": _bigint_fn,
+    "split_part": _varchar_fn,
+    "lpad": _varchar_fn, "rpad": _varchar_fn,
+    "chr": _varchar_fn,
+    "codepoint": _bigint_fn,
+    "starts_with": lambda n, a: BOOLEAN,
+    "hamming_distance": _bigint_fn,
+    "levenshtein_distance": _bigint_fn,
+    "regexp_like": lambda n, a: BOOLEAN,
+    "regexp_replace": _varchar_fn,
+    "regexp_extract": _varchar_fn,
+    "format": _varchar_fn,
+    # datetime (operator/scalar/DateTimeFunctions.java)
+    "year": _bigint_fn, "quarter": _bigint_fn, "month": _bigint_fn,
+    "week": _bigint_fn, "day": _bigint_fn, "day_of_month": _bigint_fn,
+    "day_of_week": _bigint_fn, "dow": _bigint_fn,
+    "day_of_year": _bigint_fn, "doy": _bigint_fn,
+    "year_of_week": _bigint_fn, "yow": _bigint_fn,
+    "hour": _bigint_fn, "minute": _bigint_fn, "second": _bigint_fn,
+    "millisecond": _bigint_fn,
+    "date_trunc": lambda n, a: a[1],
+    "date_add": lambda n, a: a[2],
+    "date_diff": _bigint_fn,
+    "date": lambda n, a: DATE,
+    "current_date": lambda n, a: DATE,
+    "now": lambda n, a: TimestampType(3),
+    "from_unixtime": lambda n, a: TimestampType(3),
+    "to_unixtime": _double_fn,
+    "date_format": _varchar_fn,
+    "date_parse": lambda n, a: TimestampType(3),
+    # misc
+    "typeof": _varchar_fn,
+    "hash_counts": _bigint_fn,
+    "to_hex": _varchar_fn,
+    "from_hex": lambda n, a: VARCHAR,
+    "xxhash64": _bigint_fn,
+    "cardinality": _bigint_fn,
+}
+
+
+def _err(name, args):
+    raise FunctionResolutionError(
+        f"{name}({', '.join(str(a) for a in args)}) not supported")
+
+
+def is_aggregate(name: str) -> bool:
+    return name in AGGREGATE_NAMES or name == "count"
+
+
+def is_window(name: str) -> bool:
+    return name in WINDOW_ONLY_NAMES
+
+
+def scalar_result_type(name: str, arg_types: Sequence[Type]) -> Type:
+    fn = _SCALARS.get(name)
+    if fn is None:
+        raise FunctionResolutionError(f"Function '{name}' not registered")
+    return fn(name, list(arg_types))
+
+
+def list_functions() -> List[str]:
+    return sorted(set(_SCALARS) | AGGREGATE_NAMES | WINDOW_ONLY_NAMES
+                  | {"count"})

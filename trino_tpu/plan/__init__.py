@@ -1,0 +1,1 @@
+from .nodes import *  # noqa: F401,F403

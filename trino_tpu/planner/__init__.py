@@ -1,0 +1,1 @@
+from .logical import LogicalPlanner, PlanningError  # noqa: F401

@@ -1,0 +1,430 @@
+"""Logical-plan optimizer passes.
+
+Reference parity: sql/planner/optimizations/PredicatePushDown.java +
+the Prune*Columns iterative-rule family (~45 rules, SURVEY.md Appendix
+A.2) + InlineProjections/MergeFilters. Implemented as whole-tree rewrites
+rather than a memo/rule engine — the rule set that matters for the TPU
+engine is small and the passes run once per query.
+
+Passes (in order, PlanOptimizers.java:240 analog):
+1. push_filters   — move WHERE conjuncts down; extract equi conjuncts
+                    into JoinNode criteria (turns the comma-join cross
+                    products of TPC-H q2/q3/q5… into hash joins).
+2. prune_columns  — project away unreferenced symbols all the way into
+                    TableScan assignments (generator reads less).
+3. cleanup_projects — drop identity projections.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+from typing import Dict, List, Optional, Set, Tuple
+
+from .. import rex
+from ..plan.nodes import (AggregationNode, AssignUniqueIdNode,
+                          EnforceSingleRowNode, ExchangeNode, FilterNode,
+                          JoinClause, JoinNode, LimitNode,
+                          MarkDistinctNode, OffsetNode, OutputNode,
+                          PlanNode, ProjectNode, SampleNode, SemiJoinNode,
+                          SetOpNode, SortNode, TableScanNode, TopNNode,
+                          UnionNode, ValuesNode, WindowNode)
+from ..planner.logical import SemiJoinMultiNode
+from ..rex import Call, Const, InputRef, RowExpr, TRUE
+
+
+def optimize(plan: PlanNode) -> PlanNode:
+    plan = push_filters(plan)
+    plan = prune_columns(plan)
+    plan = cleanup_projects(plan)
+    return plan
+
+
+# --------------------------------------------------------------------------
+# predicate pushdown
+# --------------------------------------------------------------------------
+
+def push_filters(node: PlanNode) -> PlanNode:
+    return _push(node, [])
+
+
+def extract_common_disjunct_conjuncts(e: RowExpr) -> List[RowExpr]:
+    """(A and X) or (A and Y) -> [A, (X or Y)] — the
+    ExtractCommonPredicates rewriter (sql/planner/iterative/rule/
+    ExtractCommonPredicatesExpressionRewriter.java). Essential for
+    TPC-H q19, whose equi-join condition lives inside every disjunct."""
+    if not (isinstance(e, Call) and e.fn == "or"):
+        return [e]
+    disjuncts: List[RowExpr] = []
+
+    def flatten_or(x):
+        if isinstance(x, Call) and x.fn == "or":
+            flatten_or(x.args[0])
+            flatten_or(x.args[1])
+        else:
+            disjuncts.append(x)
+
+    flatten_or(e)
+    conj_sets = [rex.split_conjuncts(d) for d in disjuncts]
+    common = [c for c in conj_sets[0]
+              if all(c in s for s in conj_sets[1:])]
+    if not common:
+        return [e]
+    rests = [rex.and_all([c for c in s if c not in common])
+             for s in conj_sets]
+    return common + [rex.or_all(rests)]
+
+
+def _split_normalized(e: RowExpr) -> List[RowExpr]:
+    out: List[RowExpr] = []
+    for c in rex.split_conjuncts(e):
+        out.extend(extract_common_disjunct_conjuncts(c))
+    return out
+
+
+def _push(node: PlanNode, conjuncts: List[RowExpr]) -> PlanNode:
+    if isinstance(node, FilterNode):
+        return _push(node.source,
+                     conjuncts + _split_normalized(node.predicate))
+
+    if isinstance(node, ProjectNode):
+        # inline through the projection when conjuncts only reference
+        # pass-through or cheap assignments (InlineProjections analog)
+        inlineable, keep = [], []
+        for c in conjuncts:
+            refs = rex.input_names(c)
+            if all(r in node.assignments for r in refs):
+                inlineable.append(
+                    rex.replace_inputs(c, dict(node.assignments)))
+            else:
+                keep.append(c)
+        src = _push(node.source, inlineable)
+        out: PlanNode = dc_replace(node, source=src)
+        return _wrap(out, keep)
+
+    if isinstance(node, JoinNode):
+        return _push_join(node, conjuncts)
+
+    if isinstance(node, (SemiJoinNode, SemiJoinMultiNode)):
+        # conjuncts not referencing the mark column push to the source
+        mark = node.output
+        down, keep = [], []
+        for c in conjuncts:
+            (keep if mark in rex.input_names(c) else down).append(c)
+        src = _push(node.sources[0], down)
+        filt = _push(node.sources[1], [])
+        if isinstance(node, SemiJoinNode):
+            out = dc_replace(node, source=src, filtering_source=filt)
+        else:
+            out = dc_replace(node, source=src, filtering_source=filt)
+        return _wrap(out, keep)
+
+    if isinstance(node, AggregationNode):
+        # conjuncts over group keys push below (PushPredicateThroughAgg)
+        keys = set(node.group_keys)
+        down, keep = [], []
+        for c in conjuncts:
+            (down if rex.input_names(c) <= keys else keep).append(c)
+        src = _push(node.source, down)
+        return _wrap(dc_replace(node, source=src), keep)
+
+    if isinstance(node, (SortNode, MarkDistinctNode, AssignUniqueIdNode,
+                         SampleNode, EnforceSingleRowNode, WindowNode,
+                         ExchangeNode)):
+        src = _push(node.sources[0], conjuncts
+                    if not isinstance(node, (EnforceSingleRowNode,
+                                             WindowNode, SampleNode))
+                    else [])
+        rest = (conjuncts if isinstance(node, (EnforceSingleRowNode,
+                                               WindowNode, SampleNode))
+                else [])
+        return _wrap(dc_replace(node, source=src), rest)
+
+    if isinstance(node, (LimitNode, OffsetNode, TopNNode)):
+        # cannot push through limits
+        src = _push(node.sources[0], [])
+        return _wrap(dc_replace(node, source=src), conjuncts)
+
+    if isinstance(node, UnionNode):
+        children = []
+        for child, smap in zip(node.children, node.symbol_maps):
+            mapped = [rex.replace_inputs(c, smap) for c in conjuncts]
+            children.append(_push(child, mapped))
+        return dc_replace(node, children=tuple(children))
+
+    if isinstance(node, SetOpNode):
+        lmapped = [rex.replace_inputs(c, node.left_map)
+                   for c in conjuncts]
+        rmapped = [rex.replace_inputs(c, node.right_map)
+                   for c in conjuncts]
+        return dc_replace(node, left=_push(node.left, lmapped),
+                          right=_push(node.right, rmapped))
+
+    if isinstance(node, OutputNode):
+        return dc_replace(node, source=_push(node.source, conjuncts))
+
+    # leaves (TableScan, Values, RemoteSource)
+    new_sources = tuple(_push(s, []) for s in node.sources)
+    if new_sources != node.sources and hasattr(node, "source"):
+        node = dc_replace(node, source=new_sources[0])
+    return _wrap(node, conjuncts)
+
+
+def _push_join(node: JoinNode, conjuncts: List[RowExpr]) -> PlanNode:
+    lsyms = set(node.left.output_schema())
+    rsyms = set(node.right.output_schema())
+    jt = node.join_type
+
+    left_down: List[RowExpr] = []
+    right_down: List[RowExpr] = []
+    new_criteria = list(node.criteria)
+    keep: List[RowExpr] = []
+    residual = _split_normalized(node.filter) if node.filter else []
+
+    for c in conjuncts:
+        refs = rex.input_names(c)
+        if refs and refs <= lsyms and jt in ("inner", "left", "cross"):
+            left_down.append(c)
+        elif refs and refs <= rsyms and jt in ("inner", "cross"):
+            right_down.append(c)
+        elif jt in ("inner", "cross"):
+            pair = _equi_pair(c, lsyms, rsyms)
+            if pair is not None:
+                new_criteria.append(JoinClause(*pair))
+            else:
+                residual.append(c)
+        else:
+            keep.append(c)
+
+    # residuals that are side-local can also sink; equalities surfaced
+    # by common-predicate extraction become criteria (from ON clauses)
+    final_residual = []
+    for c in residual:
+        refs = rex.input_names(c)
+        if refs and refs <= lsyms and jt in ("inner", "cross"):
+            left_down.append(c)
+        elif refs and refs <= rsyms and jt in ("inner", "cross"):
+            right_down.append(c)
+        elif jt in ("inner", "cross") and \
+                (pair := _equi_pair(c, lsyms, rsyms)) is not None:
+            new_criteria.append(JoinClause(*pair))
+        else:
+            final_residual.append(c)
+
+    left = _push(node.left, left_down)
+    right = _push(node.right, right_down)
+    new_jt = "inner" if (jt == "cross" and new_criteria) else jt
+    out = JoinNode(left, right, new_jt, tuple(new_criteria),
+                   rex.and_all(final_residual) if final_residual else None,
+                   node.distribution)
+    return _wrap(out, keep)
+
+
+def _equi_pair(c: RowExpr, lsyms: Set[str], rsyms: Set[str]):
+    if isinstance(c, Call) and c.fn == "=" and len(c.args) == 2:
+        a, b = c.args
+        if isinstance(a, InputRef) and isinstance(b, InputRef):
+            if a.name in lsyms and b.name in rsyms:
+                return (a.name, b.name)
+            if b.name in lsyms and a.name in rsyms:
+                return (b.name, a.name)
+    return None
+
+
+def _wrap(node: PlanNode, conjuncts: List[RowExpr]) -> PlanNode:
+    if not conjuncts:
+        return node
+    return FilterNode(node, rex.and_all(conjuncts))
+
+
+# --------------------------------------------------------------------------
+# column pruning
+# --------------------------------------------------------------------------
+
+def prune_columns(node: PlanNode) -> PlanNode:
+    if isinstance(node, OutputNode):
+        return dc_replace(node, source=_prune(node.source,
+                                              set(node.symbols)))
+    return _prune(node, set(node.output_schema()))
+
+
+def _prune(node: PlanNode, needed: Set[str]) -> PlanNode:
+    if isinstance(node, TableScanNode):
+        keep = {s: c for s, c in node.assignments.items() if s in needed}
+        if not keep:  # keep one column for row counting
+            s = next(iter(node.assignments))
+            keep = {s: node.assignments[s]}
+        return TableScanNode(node.handle, keep,
+                             {s: node.schema[s] for s in keep})
+
+    if isinstance(node, ProjectNode):
+        keep = {s: e for s, e in node.assignments.items() if s in needed}
+        if not keep and node.assignments:
+            s = next(iter(node.assignments))
+            keep = {s: node.assignments[s]}
+        child_needed = set()
+        for e in keep.values():
+            child_needed |= rex.input_names(e)
+        return ProjectNode(_prune(node.source, child_needed), keep)
+
+    if isinstance(node, FilterNode):
+        child_needed = needed | rex.input_names(node.predicate)
+        return FilterNode(_prune(node.source, child_needed),
+                          node.predicate)
+
+    if isinstance(node, AggregationNode):
+        child_needed = set(node.group_keys)
+        aggs = {s: a for s, a in node.aggregates.items()
+                if s in needed or not node.aggregates}
+        if not aggs and node.aggregates:
+            # aggregates all pruned -> keep none; grouping keys remain
+            aggs = {}
+        for a in aggs.values():
+            if a.argument:
+                child_needed.add(a.argument)
+            if a.mask:
+                child_needed.add(a.mask)
+        return dc_replace(node, source=_prune(node.source, child_needed),
+                          aggregates=aggs)
+
+    if isinstance(node, JoinNode):
+        child = set(needed)
+        for c in node.criteria:
+            child.add(c.left)
+            child.add(c.right)
+        if node.filter is not None:
+            child |= rex.input_names(node.filter)
+        lsyms = set(node.left.output_schema())
+        rsyms = set(node.right.output_schema())
+        return dc_replace(
+            node,
+            left=_prune(node.left, child & lsyms),
+            right=_prune(node.right, child & rsyms))
+
+    if isinstance(node, SemiJoinNode):
+        child = (needed - {node.output}) | {node.source_key}
+        return dc_replace(
+            node, source=_prune(node.source, child),
+            filtering_source=_prune(node.filtering_source,
+                                    {node.filtering_key}))
+
+    if isinstance(node, SemiJoinMultiNode):
+        child = (needed - {node.output}) | set(node.source_keys)
+        fneed = set(node.filtering_keys)
+        if node.filter is not None:
+            refs = rex.input_names(node.filter)
+            fsyms = set(node.filtering_source.output_schema())
+            child |= (refs - fsyms)
+            fneed |= (refs & fsyms)
+        return dc_replace(
+            node, source=_prune(node.source, child),
+            filtering_source=_prune(node.filtering_source, fneed))
+
+    if isinstance(node, (SortNode, TopNNode)):
+        child = needed | {k.symbol for k in node.keys}
+        return dc_replace(node, source=_prune(node.sources[0], child))
+
+    if isinstance(node, MarkDistinctNode):
+        child = (needed - {node.marker}) | set(node.keys)
+        return dc_replace(node, source=_prune(node.source, child))
+
+    if isinstance(node, AssignUniqueIdNode):
+        return dc_replace(node, source=_prune(
+            node.source, needed - {node.symbol}))
+
+    if isinstance(node, WindowNode):
+        child = needed - set(node.functions)
+        child |= set(node.partition_by)
+        child |= {k.symbol for k in node.order_by}
+        for f in node.functions.values():
+            if f.argument:
+                child.add(f.argument)
+        return dc_replace(node, source=_prune(node.source, child))
+
+    if isinstance(node, UnionNode):
+        keep_out = [s for s in node.schema if s in needed] or \
+            list(node.schema)[:1]
+        children = []
+        maps = []
+        for child, smap in zip(node.children, node.symbol_maps):
+            cneed = {smap[s] for s in keep_out}
+            children.append(_prune(child, cneed))
+            maps.append({s: smap[s] for s in keep_out})
+        return dc_replace(
+            node, children=tuple(children),
+            schema={s: node.schema[s] for s in keep_out},
+            symbol_maps=tuple(maps))
+
+    if isinstance(node, SetOpNode):
+        # set-op semantics compare whole rows; keep all columns
+        return dc_replace(node, left=_prune(
+            node.left, set(node.left_map.values())),
+            right=_prune(node.right, set(node.right_map.values())))
+
+    if isinstance(node, (LimitNode, OffsetNode, SampleNode,
+                         EnforceSingleRowNode, ExchangeNode)):
+        src = node.sources[0]
+        pruned = _prune(src, needed if not isinstance(
+            node, EnforceSingleRowNode) else set(src.output_schema()))
+        return dc_replace(node, source=pruned)
+
+    if isinstance(node, ValuesNode):
+        keep = [s for s in node.schema if s in needed] or \
+            list(node.schema)[:1]
+        idx = [list(node.schema).index(s) for s in keep]
+        return ValuesNode({s: node.schema[s] for s in keep},
+                          tuple(tuple(r[i] for i in idx)
+                                for r in node.rows))
+
+    if not node.sources:
+        return node
+    if len(node.sources) == 1 and hasattr(node, "source"):
+        return dc_replace(node, source=_prune(
+            node.sources[0], set(node.sources[0].output_schema())))
+    return node
+
+
+# --------------------------------------------------------------------------
+# project cleanup
+# --------------------------------------------------------------------------
+
+def cleanup_projects(node: PlanNode) -> PlanNode:
+    if isinstance(node, ProjectNode):
+        src = cleanup_projects(node.source)
+        if isinstance(src, ProjectNode):
+            # merge Project(Project(x)) when outer refs inline trivially
+            inlined = {}
+            simple = True
+            for s, e in node.assignments.items():
+                inlined[s] = rex.replace_inputs(e, dict(src.assignments))
+            merged = ProjectNode(src.source, inlined)
+            node = merged
+            src = merged.source
+        else:
+            node = dc_replace(node, source=src)
+        if node.is_identity and \
+                set(node.assignments) == set(node.source.output_schema()):
+            return node.source
+        return node
+    if not node.sources:
+        return node
+    import dataclasses
+    fields = {f.name for f in dataclasses.fields(node)}
+    if "source" in fields:
+        return dc_replace(node, source=cleanup_projects(node.sources[0]),
+                          **({"left": cleanup_projects(node.left),
+                              "right": cleanup_projects(node.right)}
+                             if isinstance(node, SetOpNode) else {}))
+    if isinstance(node, JoinNode):
+        return dc_replace(node, left=cleanup_projects(node.left),
+                          right=cleanup_projects(node.right))
+    if isinstance(node, (SemiJoinNode, SemiJoinMultiNode)):
+        return dc_replace(
+            node, source=cleanup_projects(node.sources[0]),
+            filtering_source=cleanup_projects(node.sources[1]))
+    if isinstance(node, UnionNode):
+        return dc_replace(node, children=tuple(
+            cleanup_projects(c) for c in node.children))
+    if isinstance(node, SetOpNode):
+        return dc_replace(node, left=cleanup_projects(node.left),
+                          right=cleanup_projects(node.right))
+    return node

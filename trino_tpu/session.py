@@ -1,0 +1,58 @@
+"""Query session: default catalog/schema + session properties.
+
+Reference parity: core/trino-main/.../Session.java +
+SystemSessionProperties.java (88 typed properties; we carry the subset the
+TPU engine consults, same names where they exist in the reference).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Tuple
+
+from .config import CONFIG
+
+_query_counter = itertools.count(1)
+
+# name -> (type, default). Mirrors SystemSessionProperties.java entries.
+SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
+    "hash_partition_count": (int, CONFIG.hash_partition_count),
+    "join_distribution_type": (str, "AUTOMATIC"),   # :53
+    "join_reordering_strategy": (str, "AUTOMATIC"),  # :85
+    "task_concurrency": (int, 1),                    # :61
+    "spill_enabled": (bool, CONFIG.spill_enabled),   # :91
+    "distributed_sort": (bool, True),                # :106
+    "enable_dynamic_filtering": (bool, True),        # :123
+    "query_max_memory_per_node": (int, CONFIG.max_query_memory_per_node),
+    "tpu_enabled": (bool, True),  # the BASELINE.json task.tpu-enabled switch
+}
+
+
+@dataclass
+class Session:
+    catalog: Optional[str] = None
+    schema: Optional[str] = None
+    user: str = "user"
+    properties: Dict[str, object] = field(default_factory=dict)
+
+    def get(self, name: str):
+        if name in self.properties:
+            return self.properties[name]
+        if name in SESSION_PROPERTIES:
+            return SESSION_PROPERTIES[name][1]
+        raise KeyError(f"Session property '{name}' does not exist")
+
+    def set(self, name: str, value) -> None:
+        if name not in SESSION_PROPERTIES:
+            raise KeyError(f"Session property '{name}' does not exist")
+        want, _ = SESSION_PROPERTIES[name]
+        if want is bool and isinstance(value, str):
+            value = value.lower() in ("true", "1", "on")
+        self.properties[name] = want(value)
+
+    def reset(self, name: str) -> None:
+        self.properties.pop(name, None)
+
+    def next_query_id(self) -> str:
+        return f"query_{next(_query_counter)}"

@@ -97,9 +97,20 @@ class _Parser:
 
     def expect_eof(self):
         t = self.peek()
-        if t.kind != "eof" and not (t.kind == "op" and t.value == ";"):
+        if t.kind == "op" and t.value == ";":
+            self.next()     # one trailing semicolon is fine ...
+            t = self.peek()
+        if t.kind != "eof":  # ... but further statements are rejected
             raise ParseError(f"unexpected trailing input {t.value!r}",
                              t.line, t.column)
+
+    def integer(self) -> int:
+        t = self.peek()
+        if t.kind != "integer":
+            raise ParseError(f"expected integer, found {t.value!r}",
+                             t.line, t.column)
+        self.next()
+        return int(t.value)
 
     def identifier(self) -> str:
         t = self.peek()
@@ -128,6 +139,10 @@ class _Parser:
             etype = "distributed"
             if self.accept_op("("):
                 while not self.accept_op(")"):
+                    if self.peek().kind == "eof":
+                        raise ParseError("unexpected end of EXPLAIN "
+                                         "options", self.peek().line,
+                                         self.peek().column)
                     if self.accept_kw("type"):
                         etype = self.identifier()
                     elif self.accept_kw("format"):
@@ -289,15 +304,18 @@ class _Parser:
             self.expect_kw("by")
             order_by = self._sort_items()
         if self.accept_kw("offset"):
-            offset = int(self.next().value)
+            offset = self.integer()
             self.accept_kw("rows", "row")
         if self.accept_kw("limit"):
-            t = self.next()
-            limit = None if t.value == "all" else int(t.value)
+            limit = None if self.accept_kw("all") else self.integer()
+            # postgres-style trailing OFFSET (Trino puts OFFSET first;
+            # accept both orders)
+            if self.accept_kw("offset"):
+                offset = self.integer()
+                self.accept_kw("rows", "row")
         if self.accept_kw("fetch"):
             self.accept_kw("first", "next")
-            t = self.next()
-            limit = int(t.value)
+            limit = self.integer()
             self.accept_kw("rows", "row")
             self.accept_kw("only")
         if isinstance(body, A.QuerySpecification) and (
@@ -792,7 +810,7 @@ class _Parser:
                 return A.RowConstructor(tuple(items))
             self.expect_op(")")
             # (x) -> y lambda
-            if self.at_op("=>") and isinstance(e, A.Identifier) \
+            if self.at_op("->", "=>") and isinstance(e, A.Identifier) \
                     and len(e.parts) == 1:
                 self.next()
                 return A.LambdaExpression((e.parts[0],), self.expression())
@@ -823,11 +841,15 @@ class _Parser:
             elif self.accept_op("+"):
                 pass
             v = self.next()
-            unit = self.identifier()
+            ut = self.peek()
+            unit = self.identifier().rstrip("s")
+            if unit not in _INTERVAL_UNITS:
+                raise ParseError(f"invalid interval unit {unit!r}",
+                                 ut.line, ut.column)
             # INTERVAL 'n' DAY TO SECOND — accept and keep leading unit
             if self.accept_kw("to"):
                 self.identifier()
-            return A.IntervalLiteral(v.value, unit.rstrip("s"), sign)
+            return A.IntervalLiteral(v.value, unit, sign)
         if kw == "case":
             return self._case()
         if kw in ("cast", "try_cast"):
@@ -924,7 +946,7 @@ class _Parser:
             return self._function_call()
         name = self.identifier()
         # single-param lambda:  x -> expr
-        if self.at_op("=>"):
+        if self.at_op("->", "=>"):
             self.next()
             return A.LambdaExpression((name,), self.expression())
         return A.Identifier((name,))

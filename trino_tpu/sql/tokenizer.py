@@ -33,7 +33,7 @@ class Token:
         return self.value.upper()
 
 
-_MULTI_OPS = ("<>", "!=", "<=", ">=", "||", "=>")
+_MULTI_OPS = ("<>", "!=", "<=", ">=", "||", "->", "=>")
 _SINGLE_OPS = "+-*/%<>=(),.;[]?:"
 
 
