@@ -1,0 +1,119 @@
+"""Benchmark: TPC-H q1 stage-pipeline throughput, rows/sec/chip.
+
+Measures the flagship pipeline (scan-filter-project-8-way-aggregate over
+sf1 lineitem, ~6M rows — BASELINE.json configs[1]) as one jitted device
+program on the default backend (the real TPU chip under the driver), and
+compares against the same engine on one host CPU worker (the
+"vs 1 CPU worker" denominator of the BASELINE.json north star, measured
+live in a subprocess rather than assumed).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+ROWS_SCALE = float(os.environ.get("BENCH_SF", "1"))
+N_ITERS = int(os.environ.get("BENCH_ITERS", "5"))
+
+
+def _gen_q1_columns(sf: float):
+    """sf lineitem columns needed by q1, straight from the generator's
+    vectorized field functions (no host string materialization)."""
+    from trino_tpu.connectors.tpch import (_LineFields, _line_counts,
+                                           CURRENTDATE, table_rows)
+    orders = table_rows("orders", sf)
+    order_idx = np.arange(1, orders + 1, dtype=np.int64)
+    counts = _line_counts(order_idx)
+    order_rep = np.repeat(order_idx, counts)
+    line_no = np.concatenate([np.arange(1, c + 1) for c in counts])
+    lf = _LineFields(order_rep, line_no.astype(np.int64), sf)
+    returned = lf.receiptdate <= CURRENTDATE
+    from trino_tpu.connectors.tpch import _u64, _SEED
+    ra = (_u64(_SEED["lineitem"] + 20, lf.rid) % np.uint64(2)).astype(
+        np.int64)
+    rflag = np.where(returned, ra, 2).astype(np.int32)
+    lstatus = (lf.shipdate > CURRENTDATE).astype(np.int32)
+    return (lf.quantity, lf.extendedprice, lf.discount, lf.tax,
+            lf.shipdate.astype(np.int32), rflag, lstatus)
+
+
+def _bench_once() -> float:
+    """Returns rows/sec of the jitted q1 pipeline on this backend."""
+    import jax
+    import jax.numpy as jnp
+    import trino_tpu  # noqa: F401  (x64)
+    from __graft_entry__ import _q1_step
+
+    cols = _gen_q1_columns(ROWS_SCALE)
+    rows = len(cols[0])
+    cap = 1
+    while cap < rows:
+        cap <<= 1
+    padded = [np.pad(c, (0, cap - rows)) for c in cols]
+    dev = [jax.device_put(jnp.asarray(c)) for c in padded]
+    n = jnp.asarray(rows, jnp.int64)
+
+    step = jax.jit(_q1_step)
+    out, ng = step(*dev, n)  # compile + warm
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(N_ITERS):
+        t0 = time.perf_counter()
+        out, ng = step(*dev, n)
+        jax.block_until_ready(out)
+        best = min(best, time.perf_counter() - t0)
+    return rows / best
+
+
+def main():
+    if "--cpu-probe" in sys.argv:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"cpu_rows_per_sec": _bench_once()}))
+        return
+
+    try:
+        tpu_rps = _bench_once()
+    except Exception as e:  # never leave the driver without a JSON line
+        print(json.dumps({"metric": "tpch_q1_sf1_rows_per_sec_per_chip",
+                          "value": 0.0, "unit": "rows/s",
+                          "vs_baseline": 0.0, "error": str(e)[:200]}))
+        return
+
+    cpu_rps = None
+    try:
+        env = dict(os.environ)
+        env["PYTHONPATH"] = ""          # skip the TPU-forcing sitecustomize
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_ITERS"] = "2"
+        probe = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu-probe"],
+            capture_output=True, text=True, timeout=900, env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in probe.stdout.splitlines():
+            line = line.strip()
+            if line.startswith("{"):
+                cpu_rps = json.loads(line).get("cpu_rows_per_sec")
+    except Exception:
+        pass
+
+    vs = (tpu_rps / cpu_rps) if cpu_rps else 0.0
+    print(json.dumps({
+        "metric": "tpch_q1_sf1_rows_per_sec_per_chip",
+        "value": round(tpu_rps, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 2),
+        "baseline": "same engine, 1 host CPU worker "
+                    f"({round(cpu_rps, 1) if cpu_rps else 'n/a'} rows/s); "
+                    "north star is >=5x (BASELINE.json)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -385,6 +385,38 @@ def empty_batch(schema: Dict[str, Type], capacity: int = 8) -> Batch:
     return Batch(cols, 0)
 
 
+# --- pytree registration ---------------------------------------------------
+# Column/Batch flow through jit/shard_map traces (the SPMD data plane,
+# parallel/spmd.py): lanes are children; type + dictionary are static
+# aux data (a new dictionary identity retraces, which is correct — the
+# compiled program embeds dictionary-derived lookup tables).
+
+def _column_flatten(c: Column):
+    return (c.data, c.valid, c.data2), (c.type, c.dictionary)
+
+
+def _column_unflatten(aux, children):
+    data, valid, data2 = children
+    typ, dictionary = aux
+    return Column(typ, data, valid, dictionary, data2)
+
+
+def _batch_flatten(b: Batch):
+    names = tuple(b.columns.keys())
+    return (tuple(b.columns[n] for n in names), b.num_rows), names
+
+
+def _batch_unflatten(names, children):
+    cols, num_rows = children
+    return Batch(dict(zip(names, cols)), num_rows)
+
+
+jax.tree_util.register_pytree_node(Column, _column_flatten,
+                                   _column_unflatten)
+jax.tree_util.register_pytree_node(Batch, _batch_flatten,
+                                   _batch_unflatten)
+
+
 def concat_batches(batches: Sequence[Batch]) -> Batch:
     """Host-side concatenation of result batches (final GATHER stage)."""
     batches = [b for b in batches if b.num_rows_host() > 0] or batches[:1]

@@ -1,0 +1,3 @@
+from .mesh import get_mesh, shard_batch, unshard_batch  # noqa: F401
+from .spmd import (distributed_group_aggregate,  # noqa: F401
+                   repartition_by_hash)

@@ -1,0 +1,218 @@
+"""SPMD collective kernels: the TPU data plane.
+
+Reference parity: the exchange layer — PartitionedOutputOperator.java:55
+(hash partition + scatter into per-partition buffers), ExchangeClient.java
+:149 (pull + merge), BroadcastOutputBuffer (replicate). TPU-first redesign
+(SURVEY.md §2.7, §7.4): REMOTE REPARTITION == ``jax.lax.all_to_all`` over
+the ICI mesh inside a ``shard_map``; REPLICATE == ``all_gather``; GATHER
+== host collect (mesh.py unshard_batch). There is no wire serde or
+pull/ack protocol inside a slice — XLA schedules the collective.
+
+The same columnar kernels (ops/groupby, ops/join, exec/expr) run
+unchanged inside the shard_map trace: a Trino *task* is the per-shard
+slice of one SPMD program. Host syncs happen only between shard_map
+calls, for data-dependent capacity decisions (the two-phase pattern of
+ops/join.py, lifted to the distributed case).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from ..columnar import Batch, Column
+from ..ops.groupby import AggInput, group_aggregate
+from ..ops.hashing import hash_columns
+from .mesh import AXIS, ShardedBatch, row_spec
+
+
+def _col_specs(cols: Dict[str, Column], spec) -> Dict[str, Column]:
+    """A pytree of PartitionSpecs shaped like the columns dict."""
+    return jax.tree.map(lambda _: spec, cols)
+
+
+# --------------------------------------------------------------------------
+# shard-level repartition (runs inside a shard_map trace)
+# --------------------------------------------------------------------------
+
+def _shard_repartition(cols: Dict[str, Column], my_n: jax.Array,
+                       key_names: Sequence[str], n_dev: int,
+                       out_cap: int) -> Tuple[Dict[str, Column],
+                                              jax.Array]:
+    """Per-shard: hash-bin rows by destination, all_to_all, compact.
+    Returns (received columns [out_cap], my new row count)."""
+    some = next(iter(cols.values()))
+    per = int(some.data.shape[0])
+    live = jnp.arange(per, dtype=jnp.int64) < my_n
+
+    h = hash_columns([cols[k] for k in key_names])
+    pid = (h % jnp.uint64(n_dev)).astype(jnp.int32)
+    sort_key = jnp.where(live, pid, n_dev)
+    order = jnp.argsort(sort_key, stable=True)
+
+    counts = jax.ops.segment_sum(
+        live.astype(jnp.int64), jnp.clip(pid, 0, n_dev - 1),
+        num_segments=n_dev)
+    starts = jnp.cumsum(counts) - counts
+
+    # send slot matrix [n_dev, per]: bin p's row j comes from
+    # order[starts[p] + j]
+    j = jnp.arange(per, dtype=jnp.int64)[None, :]
+    src = starts[:, None] + j
+    send_idx = jnp.take(order, jnp.clip(src, 0, per - 1), axis=0)
+    send_live = j < counts[:, None]
+
+    recv_counts = jax.lax.all_to_all(counts, AXIS, 0, 0)
+    new_n = jnp.sum(recv_counts)
+
+    # compact gather index over the received [n_dev, per] buffers
+    rj = jnp.arange(per, dtype=jnp.int64)[None, :]
+    recv_live = (rj < recv_counts[:, None]).reshape(-1)
+    flat_idx = jnp.nonzero(recv_live, size=out_cap, fill_value=0)[0]
+
+    out: Dict[str, Column] = {}
+    for name, c in cols.items():
+        lanes = [c.data] + ([c.valid] if c.valid is not None else []) \
+            + ([c.data2] if c.data2 is not None else [])
+        moved = []
+        for lane in lanes:
+            send = jnp.take(jnp.asarray(lane), send_idx, axis=0)
+            recv = jax.lax.all_to_all(send, AXIS, 0, 0)
+            moved.append(jnp.take(recv.reshape(-1), flat_idx, axis=0))
+        data = moved[0]
+        k = 1
+        valid = None
+        if c.valid is not None:
+            valid = moved[k]
+            k += 1
+        d2 = moved[k] if c.data2 is not None else None
+        out[name] = Column(c.type, data, valid, c.dictionary, d2)
+    return out, new_n
+
+
+def _shard_broadcast(cols: Dict[str, Column], num_rows_vec: jax.Array,
+                     out_cap: int) -> Tuple[Dict[str, Column], jax.Array]:
+    """Per-shard: replicate every shard's live rows to all shards
+    (REPLICATE exchange / broadcast join build side)."""
+    some = next(iter(cols.values()))
+    per = int(some.data.shape[0])
+    n_dev = num_rows_vec.shape[0]
+    j = jnp.arange(per, dtype=jnp.int64)[None, :]
+    live = (j < num_rows_vec[:, None]).reshape(-1)
+    flat_idx = jnp.nonzero(live, size=out_cap, fill_value=0)[0]
+    new_n = jnp.sum(num_rows_vec)
+    out: Dict[str, Column] = {}
+    for name, c in cols.items():
+        lanes = [c.data] + ([c.valid] if c.valid is not None else []) \
+            + ([c.data2] if c.data2 is not None else [])
+        moved = []
+        for lane in lanes:
+            g = jax.lax.all_gather(jnp.asarray(lane), AXIS)  # [n_dev, per]
+            moved.append(jnp.take(g.reshape(-1), flat_idx, axis=0))
+        data = moved[0]
+        k = 1
+        valid = None
+        if c.valid is not None:
+            valid = moved[k]
+            k += 1
+        d2 = moved[k] if c.data2 is not None else None
+        out[name] = Column(c.type, data, valid, c.dictionary, d2)
+    return out, new_n
+
+
+# --------------------------------------------------------------------------
+# whole-mesh operations (host API over ShardedBatch)
+# --------------------------------------------------------------------------
+
+def repartition_by_hash(sb: ShardedBatch, key_names: Sequence[str],
+                        out_cap: Optional[int] = None) -> ShardedBatch:
+    """REMOTE REPARTITION: redistribute rows so equal keys land on the
+    same shard. ``out_cap`` bounds the post-exchange per-shard capacity;
+    default is the safe worst case n_dev * per_shard_cap."""
+    n = sb.n_shards
+    cap = out_cap or n * sb.per_shard_cap
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        out, new_n = _shard_repartition(cols, my_n, key_names, n, cap)
+        counts = jax.lax.all_gather(new_n, AXIS)
+        return out, counts
+
+    mesh = sb.mesh
+    fn = shard_map(
+        f, mesh=mesh,
+        in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+        out_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+        check_vma=False)
+    cols, counts = fn(sb.columns, sb.num_rows)
+    return ShardedBatch(cols, counts, mesh, cap)
+
+
+def distributed_group_aggregate(sb: ShardedBatch,
+                                key_names: Sequence[str],
+                                aggs: Sequence[AggInput],
+                                out_cap: Optional[int] = None
+                                ) -> ShardedBatch:
+    """PARTIAL agg per shard -> all_to_all by key hash -> FINAL agg.
+
+    This is the PushPartialAggregationThroughExchange plan shape
+    (SURVEY.md §2.7 partial/final row) as one SPMD program: every
+    aggregate below declares a combine that is itself a segment op,
+    so the partial output columns feed the final step directly."""
+    n = sb.n_shards
+    partial_cap = sb.per_shard_cap
+    exch_cap = n * partial_cap if out_cap is None else out_cap
+
+    finals: List[AggInput] = []
+    for a in aggs:
+        combine = {"sum": "sum", "count": "sum", "count_star": "sum",
+                   "min": "min", "max": "max",
+                   "any_value": "any_value"}[a.kind]
+        finals.append(AggInput(combine, a.output, None, a.output))
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        local = Batch(cols, my_n)
+        part = group_aggregate(local, list(key_names), list(aggs),
+                               groups_capacity=partial_cap)
+        moved, new_n = _shard_repartition(
+            part.columns, part.num_rows_device(), key_names, n, exch_cap)
+        fin = group_aggregate(Batch(moved, new_n), list(key_names),
+                              finals, groups_capacity=exch_cap)
+        counts = jax.lax.all_gather(fin.num_rows_device(), AXIS)
+        return fin.columns, counts
+
+    mesh = sb.mesh
+    fn = shard_map(f, mesh=mesh,
+                   in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                   out_specs=(P(AXIS), P()),
+                   check_vma=False)
+    cols, counts = fn(sb.columns, sb.num_rows)
+    return ShardedBatch(cols, counts, mesh, exch_cap)
+
+
+def broadcast_sharded(sb: ShardedBatch,
+                      out_cap: Optional[int] = None) -> ShardedBatch:
+    """REPLICATE exchange: every shard ends up with every row."""
+    n = sb.n_shards
+    cap = out_cap or n * sb.per_shard_cap
+
+    def f(cols, num_rows_vec):
+        out, new_n = _shard_broadcast(cols, num_rows_vec, cap)
+        counts = jax.lax.all_gather(new_n, AXIS)
+        return out, counts
+
+    fn = shard_map(f, mesh=sb.mesh,
+                   in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                   out_specs=(P(AXIS), P()),
+                   check_vma=False)
+    cols, counts = fn(sb.columns, sb.num_rows)
+    # broadcast output is replicated per shard; counts[d] all equal total
+    return ShardedBatch(cols, counts, sb.mesh, cap)
