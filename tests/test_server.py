@@ -1,0 +1,97 @@
+"""Control-plane tests: coordinator REST protocol + client + CLI.
+
+Reference parity: the protocol behaviors of QueuedStatementResource /
+ExecutingStatementResource / StatementClientV1 (SURVEY.md §3.1) —
+submission, nextUri paging, error payloads, session properties via
+X-Trino-Session, /v1/info and /v1/query.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from trino_tpu.client import ClientError, StatementClient
+from trino_tpu.server import Coordinator
+
+
+@pytest.fixture(scope="module")
+def coordinator():
+    co = Coordinator().start()
+    yield co
+    co.stop()
+
+
+@pytest.fixture(scope="module")
+def client(coordinator):
+    return StatementClient(coordinator.base_uri)
+
+
+def test_info(coordinator):
+    with urllib.request.urlopen(
+            f"{coordinator.base_uri}/v1/info") as r:
+        info = json.loads(r.read())
+    assert info["coordinator"] is True
+
+
+def test_simple_query(client):
+    res = client.execute("SELECT 1 + 2 AS x, 'hi' AS s")
+    assert res.column_names == ["x", "s"]
+    assert res.rows == [[3, "hi"]]
+    assert res.state == "FINISHED"
+
+
+def test_query_over_tpch(client):
+    res = client.execute(
+        "SELECT l_returnflag, count(*) FROM lineitem "
+        "GROUP BY l_returnflag ORDER BY 1")
+    assert [r[0] for r in res.rows] == ["A", "N", "R"]
+
+
+def test_paging(client, coordinator):
+    # > PAGE_ROWS rows forces multiple nextUri fetches
+    res = client.execute(
+        "SELECT l_orderkey FROM lineitem LIMIT 6000")
+    assert len(res.rows) == 6000
+
+
+def test_error_payload(client):
+    with pytest.raises(ClientError, match="cannot be resolved"):
+        client.execute("SELECT nosuch FROM lineitem")
+
+
+def test_session_properties(coordinator):
+    c = StatementClient(coordinator.base_uri,
+                        session_properties={"hash_partition_count": "4"})
+    res = c.execute("SHOW SESSION")
+    row = [r for r in res.rows if r[0] == "hash_partition_count"][0]
+    assert row[1] == "4"
+
+
+def test_date_json_encoding(client):
+    res = client.execute("SELECT date '2001-08-22' AS d")
+    assert res.rows == [["2001-08-22"]]
+
+
+def test_query_list(coordinator, client):
+    client.execute("SELECT 42")
+    with urllib.request.urlopen(
+            f"{coordinator.base_uri}/v1/query") as r:
+        infos = json.loads(r.read())
+    assert any(i["state"] == "FINISHED" for i in infos)
+
+
+def test_update_statement(client):
+    res = client.execute(
+        "CREATE TABLE memory.default.srv_t AS SELECT 1 AS a")
+    assert res.update_type
+    res = client.execute("SELECT a FROM memory.default.srv_t")
+    assert res.rows == [[1]]
+
+
+def test_cli_execute(capsys):
+    from trino_tpu.cli import main
+    rc = main(["--local", "-e", "SELECT 1 AS one, 'x' AS s"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "one" in out and "1" in out and "(1 row" in out

@@ -1,0 +1,80 @@
+"""Python client for the coordinator's REST protocol.
+
+Reference parity: client/trino-client StatementClientV1.java:108,324-336
+— POST /v1/statement, then advance() through nextUri until the payload
+carries no nextUri; data rows accumulate across pages. stdlib-only
+(urllib), synchronous.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+class ClientError(Exception):
+    pass
+
+
+@dataclass
+class ClientResult:
+    columns: List[dict] = field(default_factory=list)
+    rows: List[list] = field(default_factory=list)
+    query_id: str = ""
+    state: str = ""
+    update_type: Optional[str] = None
+    update_count: Optional[int] = None
+
+    @property
+    def column_names(self) -> List[str]:
+        return [c["name"] for c in self.columns]
+
+
+class StatementClient:
+    def __init__(self, base_uri: str, user: str = "user",
+                 catalog: str = "tpch", schema: str = "tiny",
+                 session_properties: Optional[Dict[str, str]] = None,
+                 timeout: float = 600.0):
+        self.base_uri = base_uri.rstrip("/")
+        self.user = user
+        self.catalog = catalog
+        self.schema = schema
+        self.session_properties = dict(session_properties or {})
+        self.timeout = timeout
+
+    def _request(self, method: str, uri: str, body: Optional[bytes]
+                 = None) -> dict:
+        req = urllib.request.Request(uri, data=body, method=method)
+        req.add_header("X-Trino-User", self.user)
+        req.add_header("X-Trino-Catalog", self.catalog)
+        req.add_header("X-Trino-Schema", self.schema)
+        if self.session_properties:
+            req.add_header("X-Trino-Session", ",".join(
+                f"{k}={v}" for k, v in self.session_properties.items()))
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            payload = resp.read()
+        return json.loads(payload) if payload else {}
+
+    def execute(self, sql: str) -> ClientResult:
+        out = ClientResult()
+        payload = self._request("POST", f"{self.base_uri}/v1/statement",
+                                sql.encode())
+        while True:
+            out.query_id = payload.get("id", out.query_id)
+            out.state = payload.get("stats", {}).get("state", out.state)
+            if "error" in payload:
+                err = payload["error"]
+                raise ClientError(
+                    f"{err.get('errorName')}: {err.get('message')}")
+            if "columns" in payload and not out.columns:
+                out.columns = payload["columns"]
+            out.rows.extend(payload.get("data", []))
+            out.update_type = payload.get("updateType", out.update_type)
+            out.update_count = payload.get("updateCount",
+                                           out.update_count)
+            nxt = payload.get("nextUri")
+            if not nxt:
+                return out
+            payload = self._request("GET", nxt)

@@ -327,6 +327,16 @@ class Batch:
             elif t.name in ("real", "double"):
                 col = [float(data[i]) if valid[i] else None
                        for i in range(n)]
+            elif t.name == "date":
+                import datetime as _dt
+                epoch = _dt.date(1970, 1, 1).toordinal()
+                col = [_dt.date.fromordinal(int(data[i]) + epoch)
+                       if valid[i] else None for i in range(n)]
+            elif t.name.startswith("timestamp"):
+                import datetime as _dt
+                col = [(_dt.datetime(1970, 1, 1)
+                        + _dt.timedelta(milliseconds=int(data[i])))
+                       if valid[i] else None for i in range(n)]
             else:
                 col = [int(data[i]) if valid[i] else None for i in range(n)]
             out_cols.append(col)

@@ -65,7 +65,6 @@ def _shard_repartition(cols: Dict[str, Column], my_n: jax.Array,
     j = jnp.arange(per, dtype=jnp.int64)[None, :]
     src = starts[:, None] + j
     send_idx = jnp.take(order, jnp.clip(src, 0, per - 1), axis=0)
-    send_live = j < counts[:, None]
 
     recv_counts = jax.lax.all_to_all(counts, AXIS, 0, 0)
     new_n = jnp.sum(recv_counts)
@@ -196,6 +195,85 @@ def distributed_group_aggregate(sb: ShardedBatch,
                    check_vma=False)
     cols, counts = fn(sb.columns, sb.num_rows)
     return ShardedBatch(cols, counts, mesh, exch_cap)
+
+
+def shard_apply(sb: ShardedBatch, fn, out_cap: Optional[int] = None
+                ) -> ShardedBatch:
+    """Run a Batch->Batch transformation independently on every shard
+    (the intra-task pipeline segment between exchanges: filter/project/
+    partial ops — SURVEY.md §2.7 intra-node row). ``fn`` must keep the
+    capacity at ``out_cap`` (default: unchanged)."""
+    cap = out_cap or sb.per_shard_cap
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        out = fn(Batch(cols, num_rows_vec[d]))
+        counts = jax.lax.all_gather(out.num_rows_device(), AXIS)
+        return out.columns, counts
+
+    g = shard_map(f, mesh=sb.mesh,
+                  in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                  out_specs=(P(AXIS), P()),
+                  check_vma=False)
+    cols, counts = g(sb.columns, sb.num_rows)
+    return ShardedBatch(cols, counts, sb.mesh, cap)
+
+
+def shard_totals(sb: ShardedBatch, fn) -> jax.Array:
+    """Per-shard scalar reduction (e.g. join-size phase 1): fn(Batch) ->
+    int scalar; returns the [n_dev] vector (host-readable)."""
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        t = fn(Batch(cols, num_rows_vec[d]))
+        return jax.lax.all_gather(t, AXIS)
+
+    g = shard_map(f, mesh=sb.mesh,
+                  in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                  out_specs=P(),
+                  check_vma=False)
+    return g(sb.columns, sb.num_rows)
+
+
+def shard_apply2(sa: ShardedBatch, b_host: Batch, fn,
+                 out_cap: int) -> ShardedBatch:
+    """Per-shard transformation with a REPLICATED second operand (a
+    broadcast-join build side / filtering source): fn(shard Batch,
+    replicated Batch) -> Batch of capacity out_cap."""
+
+    def f(cols, num_rows_vec, bcols, bn):
+        d = jax.lax.axis_index(AXIS)
+        out = fn(Batch(cols, num_rows_vec[d]), Batch(bcols, bn))
+        counts = jax.lax.all_gather(out.num_rows_device(), AXIS)
+        return out.columns, counts
+
+    g = shard_map(
+        f, mesh=sa.mesh,
+        in_specs=(_col_specs(sa.columns, P(AXIS)), P(),
+                  _col_specs(b_host.columns, P()), P()),
+        out_specs=(P(AXIS), P()),
+        check_vma=False)
+    cols, counts = g(sa.columns, sa.num_rows, b_host.columns,
+                     jnp.asarray(b_host.num_rows_host(), jnp.int64))
+    return ShardedBatch(cols, counts, sa.mesh, out_cap)
+
+
+def shard_totals2(sa: ShardedBatch, b_host: Batch, fn) -> jax.Array:
+    """Per-shard scalar with replicated second operand."""
+
+    def f(cols, num_rows_vec, bcols, bn):
+        d = jax.lax.axis_index(AXIS)
+        t = fn(Batch(cols, num_rows_vec[d]), Batch(bcols, bn))
+        return jax.lax.all_gather(t, AXIS)
+
+    g = shard_map(
+        f, mesh=sa.mesh,
+        in_specs=(_col_specs(sa.columns, P(AXIS)), P(),
+                  _col_specs(b_host.columns, P()), P()),
+        out_specs=P(),
+        check_vma=False)
+    return g(sa.columns, sa.num_rows, b_host.columns,
+             jnp.asarray(b_host.num_rows_host(), jnp.int64))
 
 
 def broadcast_sharded(sb: ShardedBatch,

@@ -49,14 +49,35 @@ class QueryResult:
 
 
 class LocalQueryRunner:
+    """In-process runner; with ``distributed=True`` executes over the
+    device mesh (the DistributedQueryRunner analog — N mesh devices play
+    the N workers, SURVEY.md §4 tier 2)."""
+
     def __init__(self, session: Optional[Session] = None,
-                 with_tpch: bool = True):
-        self.catalogs = CatalogManager()
-        if with_tpch:
-            self.catalogs.register("tpch", TpchConnector())
-        self.catalogs.register("memory", MemoryConnector())
-        self.catalogs.register("blackhole", BlackholeConnector())
+                 with_tpch: bool = True, distributed: bool = False,
+                 n_devices: Optional[int] = None,
+                 catalogs: Optional[CatalogManager] = None,
+                 mesh=None):
+        if catalogs is not None:
+            self.catalogs = catalogs
+        else:
+            self.catalogs = CatalogManager()
+            if with_tpch:
+                self.catalogs.register("tpch", TpchConnector())
+            self.catalogs.register("memory", MemoryConnector())
+            self.catalogs.register("blackhole", BlackholeConnector())
         self.session = session or Session(catalog="tpch", schema="tiny")
+        self.mesh = mesh
+        if distributed and self.mesh is None:
+            from .parallel.mesh import get_mesh
+            self.mesh = get_mesh(n_devices)
+
+    def _make_executor(self, collect_stats: bool = False) -> Executor:
+        if self.mesh is not None:
+            from .exec.distributed import DistributedExecutor
+            return DistributedExecutor(self.catalogs, self.session,
+                                       self.mesh, collect_stats)
+        return Executor(self.catalogs, self.session, collect_stats)
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
@@ -175,7 +196,7 @@ class LocalQueryRunner:
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = planner.plan(stmt)
         plan = optimize(plan)
-        ex = Executor(self.catalogs, self.session, collect_stats)
+        ex = self._make_executor(collect_stats)
         batch = ex.execute(plan)
         schema = batch.schema()
         types = [schema[s] for s in plan.symbols]

@@ -1,0 +1,309 @@
+"""Coordinator: the client-facing HTTP control plane.
+
+Reference parity: the dispatch + statement resources —
+dispatcher/QueuedStatementResource.java:93 (POST /v1/statement),
+server/protocol/ExecutingStatementResource.java:76
+(/v1/statement/executing), QueryResults paging with nextUri tokens
+(client/trino-client/.../StatementClientV1.java:324-336), /v1/info and
+/v1/query (server/QueryResource.java), X-Trino-* headers
+(ProtocolHeaders.java:24). Implemented on the stdlib ThreadingHTTPServer
+— the engine below it is the in-process mesh runtime, so there is no
+separate worker fleet to dispatch to over HTTP: a "stage" of remote
+tasks is the SPMD program of exec/distributed.py (SURVEY.md §7.4/§7.5;
+multi-host DCN dispatch is the designed extension point).
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import itertools
+import json
+import threading
+import time
+import traceback
+import uuid
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from ..runner import LocalQueryRunner, QueryResult
+from ..session import Session
+
+PAGE_ROWS = 4096     # rows per QueryResults page
+
+
+def _json_value(v):
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat(sep=" ") if isinstance(v, datetime.datetime) \
+            else v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    return v
+
+
+@dataclass
+class _Query:
+    """Per-query state machine (execution/QueryStateMachine.java:
+    QUEUED -> RUNNING -> FINISHED | FAILED | CANCELED)."""
+    query_id: str
+    slug: str
+    sql: str
+    session: Session
+    state: str = "QUEUED"
+    error: Optional[dict] = None
+    result: Optional[QueryResult] = None
+    created: float = field(default_factory=time.time)
+    _done: threading.Event = field(default_factory=threading.Event)
+
+    def run(self, runner_factory):
+        self.state = "RUNNING"
+        try:
+            runner = runner_factory(self.session)
+            result = runner.execute(self.sql)
+            if self.state != "CANCELED":
+                self.result = result
+                self.state = "FINISHED"
+        except Exception as e:   # error taxonomy: Appendix A.8
+            if self.state == "CANCELED":
+                return
+            self.state = "FAILED"
+            name = type(e).__name__
+            self.error = {
+                "message": str(e),
+                "errorCode": 1,
+                "errorName": ("SYNTAX_ERROR"
+                              if "SYNTAX_ERROR" in str(e)
+                              else "GENERIC_INTERNAL_ERROR"),
+                "errorType": ("USER_ERROR" if name == "QueryError"
+                              else "INTERNAL_ERROR"),
+                "failureInfo": {"type": name,
+                                "stack": traceback.format_exc()
+                                .splitlines()[-5:]},
+            }
+        finally:
+            self._done.set()
+
+    def wait_done(self, timeout: float) -> bool:
+        return self._done.wait(timeout)
+
+
+class QueryTracker:
+    """dispatcher/DispatchManager + execution/QueryTracker: owns every
+    query's lifecycle; one executor thread per query."""
+
+    def __init__(self, make_runner):
+        self._queries: Dict[str, _Query] = {}
+        self._lock = threading.Lock()
+        self._counter = itertools.count(1)
+        self._make_runner = make_runner
+
+    def submit(self, sql: str, session: Session) -> _Query:
+        qid = (time.strftime("%Y%m%d_%H%M%S") +
+               f"_{next(self._counter):05d}")
+        q = _Query(qid, uuid.uuid4().hex[:16], sql, session)
+        with self._lock:
+            self._queries[qid] = q
+        threading.Thread(target=q.run, args=(self._make_runner,),
+                         daemon=True).start()
+        return q
+
+    def get(self, qid: str) -> Optional[_Query]:
+        with self._lock:
+            return self._queries.get(qid)
+
+    def all(self) -> List[_Query]:
+        with self._lock:
+            return list(self._queries.values())
+
+    def cancel(self, qid: str):
+        q = self.get(qid)
+        if q is not None and q.state in ("QUEUED", "RUNNING"):
+            q.state = "CANCELED"   # cooperative; execution thread ends
+            q._done.set()
+
+
+class Coordinator:
+    """HTTP server wrapper. ``start()`` binds an ephemeral (or given)
+    port; ``base_uri`` mirrors server/Server.java's announcement."""
+
+    def __init__(self, port: int = 0, distributed: bool = False,
+                 catalogs=None):
+        self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
+        self.started = time.time()
+        self._distributed = distributed
+        self._catalogs = catalogs
+
+        # one shared CatalogManager (memory-connector state spans
+        # queries) and one shared mesh
+        self._proto = LocalQueryRunner(distributed=distributed,
+                                       catalogs=self._catalogs)
+        self._catalogs = self._proto.catalogs
+
+        def make_runner(session: Session) -> LocalQueryRunner:
+            return LocalQueryRunner(session=session,
+                                    catalogs=self._catalogs,
+                                    mesh=self._proto.mesh)
+
+        self.tracker = QueryTracker(make_runner)
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
+                                          _make_handler(self))
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def base_uri(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+
+    # ---- resource payloads -------------------------------------------
+    def query_results(self, q: _Query, token: int) -> dict:
+        uri = f"{self.base_uri}/v1/statement/executing/{q.query_id}" \
+              f"/{q.slug}"
+        out = {
+            "id": q.query_id,
+            "infoUri": f"{self.base_uri}/ui/query.html?{q.query_id}",
+            "stats": {"state": q.state,
+                      "queued": q.state == "QUEUED",
+                      "scheduled": q.state in ("RUNNING", "FINISHED"),
+                      "elapsedTimeMillis":
+                          int((time.time() - q.created) * 1000)},
+            "warnings": [],
+        }
+        if q.state == "FAILED":
+            out["error"] = q.error
+            return out
+        if q.state == "CANCELED":
+            out["error"] = {"message": "Query was canceled",
+                            "errorCode": 2, "errorName": "USER_CANCELED",
+                            "errorType": "USER_ERROR"}
+            return out
+        if q.state in ("QUEUED", "RUNNING") or q.result is None:
+            out["nextUri"] = f"{uri}/{token}"
+            return out
+        res = q.result
+        if res.update_type is not None:
+            out["updateType"] = res.update_type
+            if res.update_count is not None:
+                out["updateCount"] = res.update_count
+        start = token * PAGE_ROWS
+        chunk = res.rows[start:start + PAGE_ROWS]
+        if res.columns:
+            out["columns"] = [
+                {"name": n, "type": t.name,
+                 "typeSignature": {"rawType": t.name.split("(")[0],
+                                   "arguments": []}}
+                for n, t in zip(res.columns, res.types)]
+            if chunk:
+                out["data"] = [[_json_value(v) for v in row]
+                               for row in chunk]
+        if start + PAGE_ROWS < len(res.rows):
+            out["nextUri"] = f"{uri}/{token + 1}"
+        return out
+
+    def info(self) -> dict:
+        return {"nodeVersion": {"version": "trino-tpu-0.1"},
+                "environment": "tpu",
+                "coordinator": True,
+                "starting": False,
+                "nodeId": self.node_id,
+                "uptime": f"{time.time() - self.started:.0f}s"}
+
+    def query_infos(self) -> list:
+        return [{"queryId": q.query_id, "state": q.state,
+                 "query": q.sql,
+                 "elapsedTimeMillis":
+                     int((time.time() - q.created) * 1000)}
+                for q in self.tracker.all()]
+
+
+def _make_handler(co: Coordinator):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):   # quiet
+            pass
+
+        def _send(self, code: int, payload):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_POST(self):
+            path = urlparse(self.path).path
+            if path == "/v1/statement":
+                n = int(self.headers.get("Content-Length", 0))
+                sql = self.rfile.read(n).decode()
+                session = Session(
+                    catalog=self.headers.get("X-Trino-Catalog", "tpch"),
+                    schema=self.headers.get("X-Trino-Schema", "tiny"),
+                    user=self.headers.get("X-Trino-User", "user"))
+                for kv in (self.headers.get("X-Trino-Session") or "") \
+                        .split(","):
+                    if "=" in kv:
+                        k, v = kv.split("=", 1)
+                        try:
+                            session.set(k.strip(), v.strip())
+                        except KeyError:
+                            pass
+                q = co.tracker.submit(sql, session)
+                q.wait_done(0.05)   # fast queries answer immediately
+                self._send(200, co.query_results(q, 0))
+                return
+            self._send(404, {"error": "not found"})
+
+        def do_GET(self):
+            path = urlparse(self.path).path
+            parts = [p for p in path.split("/") if p]
+            if path == "/v1/info":
+                self._send(200, co.info())
+                return
+            if path == "/v1/query":
+                self._send(200, co.query_infos())
+                return
+            if len(parts) == 3 and parts[:2] == ["v1", "query"]:
+                q = co.tracker.get(parts[2])
+                if q is None:
+                    self._send(404, {"error": "no such query"})
+                    return
+                self._send(200, {"queryId": q.query_id,
+                                 "state": q.state, "query": q.sql,
+                                 "error": q.error})
+                return
+            # /v1/statement/executing/{id}/{slug}/{token}
+            if len(parts) == 6 and parts[:3] == ["v1", "statement",
+                                                 "executing"]:
+                q = co.tracker.get(parts[3])
+                if q is None or q.slug != parts[4]:
+                    self._send(404, {"error": "no such query"})
+                    return
+                q.wait_done(1.0)   # long-poll like the reference
+                self._send(200, co.query_results(q, int(parts[5])))
+                return
+            self._send(404, {"error": "not found"})
+
+        def do_DELETE(self):
+            parts = [p for p in urlparse(self.path).path.split("/") if p]
+            if len(parts) >= 4 and parts[:2] == ["v1", "statement"]:
+                co.tracker.cancel(parts[3])
+                # 204 carries no body (RFC 7230; a body would desync
+                # keep-alive clients)
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            self._send(404, {"error": "not found"})
+
+    return Handler
