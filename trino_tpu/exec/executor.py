@@ -56,25 +56,32 @@ class NodeStats:
     output_rows: int = -1
 
 
+# plan nodes whose _apply_ is pure jnp (traceable): a chain of these over
+# one source compiles into a single XLA program — the reference's
+# "one bytecode class per pipeline" (ExpressionCompiler) as jax.jit
+# (SURVEY.md §7.2)
+_TRACEABLE = ()  # filled after class definition
+
+
 class Executor:
     def __init__(self, catalogs: CatalogManager, session: Session,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False,
+                 fragment_jit: Optional[bool] = None):
         self.catalogs = catalogs
         self.session = session
         self.collect_stats = collect_stats
         self.stats: List[NodeStats] = []
+        if fragment_jit is None:
+            # eager dispatch through the device tunnel is the bottleneck
+            # on TPU; on CPU the compile cost dominates short queries
+            fragment_jit = jax.default_backend() not in ("cpu",)
+        self.fragment_jit = fragment_jit
+        self._no_jit_chains: set = set()
 
     # ------------------------------------------------------------------
     def execute(self, node: PlanNode) -> Batch:
         t0 = time.perf_counter() if self.collect_stats else 0.0
-        method = getattr(self, "_exec_" + type(node).__name__, None)
-        if method is None:
-            raise QueryError(
-                f"no executor for plan node {type(node).__name__}")
-        try:
-            out = method(node)
-        except EvalError as e:
-            raise QueryError(str(e)) from e
+        out = self._execute_inner(node)
         if self.collect_stats:
             # blocking read for accurate per-node timing
             n = out.num_rows_host()
@@ -82,6 +89,50 @@ class Executor:
                 type(node).__name__.replace("Node", ""),
                 wall_s=time.perf_counter() - t0, output_rows=n))
         return out
+
+    def _execute_inner(self, node: PlanNode) -> Batch:
+        if self.fragment_jit and isinstance(node, _TRACEABLE):
+            chain = []
+            cur = node
+            while isinstance(cur, _TRACEABLE):
+                chain.append(cur)
+                cur = cur.source
+            key = tuple(id(n) for n in chain)
+            base = self.execute(cur)
+            if key not in self._no_jit_chains:
+                try:
+                    return self._run_chain_jit(chain, base)
+                except (jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError):
+                    # chain touches host-only paths (row-materializing
+                    # string fns); run it eagerly from here on
+                    self._no_jit_chains.add(key)
+            b = base
+            for nd in reversed(chain):
+                b = self._dispatch_apply(nd, b)
+            return b
+        method = getattr(self, "_exec_" + type(node).__name__, None)
+        if method is None:
+            raise QueryError(
+                f"no executor for plan node {type(node).__name__}")
+        try:
+            return method(node)
+        except EvalError as e:
+            raise QueryError(str(e)) from e
+
+    def _dispatch_apply(self, node: PlanNode, src: Batch) -> Batch:
+        try:
+            return getattr(self, "_apply_" + type(node).__name__)(
+                node, src)
+        except EvalError as e:
+            raise QueryError(str(e)) from e
+
+    def _run_chain_jit(self, chain, base: Batch) -> Batch:
+        def fn(b):
+            for nd in reversed(chain):
+                b = self._dispatch_apply(nd, b)
+            return b
+        return jax.jit(fn)(base)
 
     # ------------------------------------------------------------------
     # leaves
@@ -106,12 +157,16 @@ class Executor:
     # row transforms
     # ------------------------------------------------------------------
     def _exec_FilterNode(self, node: FilterNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_FilterNode(node, self.execute(node.source))
+
+    def _apply_FilterNode(self, node: FilterNode, src: Batch) -> Batch:
         mask = eval_predicate(node.predicate, src)
         return compact.filter_batch(src, mask)
 
     def _exec_ProjectNode(self, node: ProjectNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_ProjectNode(node, self.execute(node.source))
+
+    def _apply_ProjectNode(self, node: ProjectNode, src: Batch) -> Batch:
         cols = {s: eval_expr(e, src)
                 for s, e in node.assignments.items()}
         return Batch(cols, src.num_rows)
@@ -122,32 +177,47 @@ class Executor:
                      src.num_rows)
 
     def _exec_LimitNode(self, node: LimitNode) -> Batch:
-        return compact.limit_batch(self.execute(node.source), node.count)
+        return self._apply_LimitNode(node, self.execute(node.source))
+
+    def _apply_LimitNode(self, node: LimitNode, src: Batch) -> Batch:
+        return compact.limit_batch(src, node.count)
 
     def _exec_OffsetNode(self, node: OffsetNode) -> Batch:
-        return compact.offset_batch(self.execute(node.source), node.count)
+        return self._apply_OffsetNode(node, self.execute(node.source))
+
+    def _apply_OffsetNode(self, node: OffsetNode, src: Batch) -> Batch:
+        return compact.offset_batch(src, node.count)
 
     def _exec_SortNode(self, node: SortNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_SortNode(node, self.execute(node.source))
+
+    def _apply_SortNode(self, node: SortNode, src: Batch) -> Batch:
         keys = [sort_ops.SortKey(k.symbol, k.ascending, k.nulls_first)
                 for k in node.keys]
         return sort_ops.sort_batch(src, keys)
 
     def _exec_TopNNode(self, node: TopNNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_TopNNode(node, self.execute(node.source))
+
+    def _apply_TopNNode(self, node: TopNNode, src: Batch) -> Batch:
         keys = [sort_ops.SortKey(k.symbol, k.ascending, k.nulls_first)
                 for k in node.keys]
         return sort_ops.topn_batch(src, keys, node.count)
 
     def _exec_SampleNode(self, node: SampleNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_SampleNode(node, self.execute(node.source))
+
+    def _apply_SampleNode(self, node: SampleNode, src: Batch) -> Batch:
         from ..ops.hashing import mix64
         h = mix64(jnp.arange(src.capacity, dtype=jnp.uint64))
         u = (h >> jnp.uint64(11)).astype(jnp.float64) / float(1 << 53)
         return compact.filter_batch(src, u < node.ratio)
 
     def _exec_AssignUniqueIdNode(self, node: AssignUniqueIdNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_AssignUniqueIdNode(
+            node, self.execute(node.source))
+
+    def _apply_AssignUniqueIdNode(self, node, src: Batch) -> Batch:
         cols = dict(src.columns)
         cols[node.symbol] = Column(
             BIGINT, jnp.arange(src.capacity, dtype=jnp.int64), None)
@@ -172,7 +242,11 @@ class Executor:
     # aggregation
     # ------------------------------------------------------------------
     def _exec_AggregationNode(self, node: AggregationNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_AggregationNode(
+            node, self.execute(node.source))
+
+    def _apply_AggregationNode(self, node: AggregationNode,
+                               src: Batch) -> Batch:
         phys, post, extra_cols = _lower_aggregates(node.aggregates, src)
         if extra_cols:
             cols = dict(src.columns)
@@ -194,7 +268,11 @@ class Executor:
         return out
 
     def _exec_MarkDistinctNode(self, node: MarkDistinctNode) -> Batch:
-        src = self.execute(node.source)
+        return self._apply_MarkDistinctNode(
+            node, self.execute(node.source))
+
+    def _apply_MarkDistinctNode(self, node: MarkDistinctNode,
+                                src: Batch) -> Batch:
         from ..ops.groupby import _key_lanes
         lanes = _key_lanes(src, list(node.keys))
         order = jnp.lexsort(lanes[::-1])
@@ -451,6 +529,11 @@ class Executor:
 
     def _single_row(self, src: Batch) -> Batch:
         return _single_row(src)
+
+
+_TRACEABLE = (FilterNode, ProjectNode, LimitNode, OffsetNode, SortNode,
+              TopNNode, SampleNode, AssignUniqueIdNode, MarkDistinctNode,
+              AggregationNode)
 
 
 def _flip_clause(c):
