@@ -1,0 +1,168 @@
+"""Feature tests: grouping sets, quantified comparisons, correlated IN,
+information_schema, DELETE, CBO plan shape.
+
+Reference parity anchors: GroupIdNode (plan/GroupIdNode.java),
+QuantifiedComparison rewrites, TransformCorrelatedInPredicateToJoin,
+connector/informationschema/, TableDeleteNode, and
+DetermineJoinDistributionType / build-side selection (cost/)."""
+
+import pytest
+
+from trino_tpu.exec import QueryError
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def test_rollup(runner):
+    res = runner.execute("""
+        SELECT l_returnflag, l_linestatus, count(*) AS n FROM lineitem
+        GROUP BY ROLLUP (l_returnflag, l_linestatus)
+        ORDER BY l_returnflag, l_linestatus""")
+    total = runner.execute("SELECT count(*) FROM lineitem").rows[0][0]
+    grand = [r for r in res.rows if r[0] is None and r[1] is None]
+    assert grand == [[None, None, total]]
+    flags = [r for r in res.rows if r[0] is not None and r[1] is None]
+    assert sum(r[2] for r in flags) == total
+
+
+def test_cube_set_count(runner):
+    res = runner.execute("""
+        SELECT l_returnflag, l_linestatus, count(*) FROM lineitem
+        GROUP BY CUBE (l_returnflag, l_linestatus)""")
+    # 3 flags x 2 statuses is sparse (A/R only pair with F): the cube has
+    # detail(4) + by-flag(3) + by-status(2) + grand(1)
+    assert len(res.rows) == 10
+
+
+def test_grouping_sets_explicit(runner):
+    res = runner.execute("""
+        SELECT l_returnflag, l_linestatus, count(*) FROM lineitem
+        GROUP BY GROUPING SETS ((l_returnflag), (l_linestatus))
+        ORDER BY 1, 2""")
+    assert len(res.rows) == 5  # 3 flags + 2 statuses
+    assert all((r[0] is None) != (r[1] is None) for r in res.rows)
+
+
+def test_quantified_all_any(runner):
+    q = runner.execute
+    assert q("SELECT 5 > ALL (SELECT x FROM (VALUES (1),(3)) t(x))"
+             ).rows == [[True]]
+    assert q("SELECT 2 > ALL (SELECT x FROM (VALUES (1),(3)) t(x))"
+             ).rows == [[False]]
+    assert q("SELECT 5 > ALL (SELECT x FROM (VALUES (1),(NULL)) t(x))"
+             ).rows == [[None]]
+    assert q("SELECT 1 > ALL (SELECT x FROM (VALUES (2)) t(x) "
+             "WHERE x > 99)").rows == [[True]]
+    assert q("SELECT 0 > ANY (SELECT x FROM (VALUES (1),(NULL)) t(x))"
+             ).rows == [[None]]
+    assert q("SELECT 2 >= ANY (SELECT x FROM (VALUES (1),(NULL)) t(x))"
+             ).rows == [[True]]
+    assert q("SELECT 9 = ANY (SELECT x FROM (VALUES (9)) t(x))"
+             ).rows == [[True]]
+    assert q("SELECT 9 <> ALL (SELECT x FROM (VALUES (1),(2)) t(x))"
+             ).rows == [[True]]
+
+
+def test_correlated_in(runner):
+    res = runner.execute("""
+        SELECT count(*) FROM orders o WHERE o.o_orderkey IN
+          (SELECT l.l_orderkey FROM lineitem l
+           WHERE l.l_orderkey = o.o_orderkey AND l.l_quantity = 50)""")
+    ref = runner.execute(
+        "SELECT count(DISTINCT l_orderkey) FROM lineitem "
+        "WHERE l_quantity = 50")
+    assert res.rows[0][0] == ref.rows[0][0]
+
+
+def test_information_schema(runner):
+    res = runner.execute(
+        "SELECT table_name FROM information_schema.tables "
+        "WHERE table_schema = 'tiny' ORDER BY 1")
+    assert ["lineitem"] in res.rows and ["region"] in res.rows
+    res = runner.execute(
+        "SELECT column_name, data_type FROM information_schema.columns "
+        "WHERE table_schema = 'tiny' AND table_name = 'nation' "
+        "ORDER BY ordinal_position")
+    assert res.rows[0] == ["n_nationkey", "bigint"]
+    res = runner.execute(
+        "SELECT schema_name FROM information_schema.schemata")
+    assert ["sf100"] in res.rows
+
+
+def test_delete(runner):
+    runner.execute("CREATE TABLE memory.default.del_t AS "
+                   "SELECT * FROM (VALUES (1),(2),(3),(NULL)) t(x)")
+    d = runner.execute("DELETE FROM memory.default.del_t WHERE x >= 2")
+    assert d.update_count == 2
+    # NULL predicate rows survive (3VL: not TRUE)
+    res = runner.execute(
+        "SELECT x FROM memory.default.del_t ORDER BY x")
+    assert res.rows == [[1], [None]]
+    d = runner.execute("DELETE FROM memory.default.del_t")
+    assert d.update_count == 2
+    assert runner.execute(
+        "SELECT count(*) FROM memory.default.del_t").rows == [[0]]
+    runner.execute("DROP TABLE memory.default.del_t")
+
+
+def test_join_build_side_selection(runner):
+    # CBO must put the big table (lineitem) on the probe (left) side
+    from trino_tpu.plan.nodes import JoinNode, TableScanNode
+
+    plan = runner.plan_sql("""
+        SELECT count(*) FROM nation, lineitem
+        WHERE n_nationkey = l_suppkey""")
+
+    def find_join(n):
+        if isinstance(n, JoinNode):
+            return n
+        for s in n.sources:
+            j = find_join(s)
+            if j is not None:
+                return j
+        return None
+
+    join = find_join(plan)
+    assert join is not None
+
+    def scans(n):
+        if isinstance(n, TableScanNode):
+            yield n.handle.table
+        for s in n.sources:
+            yield from scans(s)
+
+    assert "lineitem" in set(scans(join.left))
+    assert "nation" in set(scans(join.right))
+    assert join.distribution == "replicated"
+
+
+def test_rollup_aggregate_over_key(runner):
+    # aggregate argument == grouping key: subtotal rows must aggregate
+    # the real values, not the nulled key lane
+    res = runner.execute("""
+        SELECT x, sum(x) AS s, count(x) AS c
+        FROM (VALUES (1),(2),(3)) t(x) GROUP BY ROLLUP (x)
+        ORDER BY x""")
+    grand = [r for r in res.rows if r[0] is None][0]
+    assert grand[1] == 6 and grand[2] == 3
+
+
+def test_delete_with_date_column(runner):
+    runner.execute("CREATE TABLE memory.default.del_d AS "
+                   "SELECT o_orderkey, o_orderdate FROM orders LIMIT 10")
+    d = runner.execute(
+        "DELETE FROM memory.default.del_d WHERE o_orderkey > 0")
+    assert d.update_count == 10
+    runner.execute("DROP TABLE memory.default.del_d")
+
+
+def test_correlated_not_in_rejected(runner):
+    with pytest.raises(QueryError, match="NOT IN"):
+        runner.execute("""
+            SELECT count(*) FROM orders o WHERE o.o_orderkey NOT IN
+              (SELECT l_orderkey FROM lineitem l
+               WHERE l.l_orderkey = o.o_orderkey)""")

@@ -184,10 +184,16 @@ def _to_lane(values, typ: Type):
     any_null = False
     long_decimal = isinstance(typ, DecimalType) and not typ.is_short
     data2 = np.zeros(n, dtype=np.int64) if long_decimal else None
+    import datetime as _dt
     for i, v in enumerate(values):
         if v is None:
             valid[i] = False
             any_null = True
+        elif isinstance(v, _dt.datetime):
+            data[i] = int((v - _dt.datetime(1970, 1, 1))
+                          .total_seconds() * 1000)
+        elif isinstance(v, _dt.date):
+            data[i] = v.toordinal() - 719163  # 1970-01-01
         elif isinstance(typ, DecimalType):
             if isinstance(v, int):
                 q = v * (10 ** typ.scale)

@@ -54,6 +54,12 @@ class MemoryConnector(Connector):
         batches.append(batch)
         return batch.num_rows_host()
 
+    def replace(self, schema: str, table: str, batch: Batch) -> None:
+        """Swap table contents (DELETE rewrites the survivors)."""
+        meta, _ = self._tables[(schema, table)]
+        batch = batch.rename(dict(zip(batch.names, meta.column_names)))
+        self._tables[(schema, table)] = (meta, [batch])
+
     def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
         meta, batches = self._tables[(split.handle.schema,
                                       split.handle.table)]

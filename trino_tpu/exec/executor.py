@@ -77,6 +77,7 @@ class Executor:
             fragment_jit = jax.default_backend() not in ("cpu",)
         self.fragment_jit = fragment_jit
         self._no_jit_chains: set = set()
+        self._jit_chains: dict = {}
 
     # ------------------------------------------------------------------
     def execute(self, node: PlanNode) -> Batch:
@@ -101,7 +102,7 @@ class Executor:
             base = self.execute(cur)
             if key not in self._no_jit_chains:
                 try:
-                    return self._run_chain_jit(chain, base)
+                    return self._run_chain_jit(key, chain, base)
                 except (jax.errors.TracerArrayConversionError,
                         jax.errors.ConcretizationTypeError):
                     # chain touches host-only paths (row-materializing
@@ -127,12 +128,19 @@ class Executor:
         except EvalError as e:
             raise QueryError(str(e)) from e
 
-    def _run_chain_jit(self, chain, base: Batch) -> Batch:
-        def fn(b):
-            for nd in reversed(chain):
-                b = self._dispatch_apply(nd, b)
-            return b
-        return jax.jit(fn)(base)
+    def _run_chain_jit(self, key, chain, base: Batch) -> Batch:
+        # cache the jitted callable per chain so repeated executions of
+        # the same plan reuse the compiled XLA program (jax.jit's cache
+        # is keyed on function identity)
+        jitted = self._jit_chains.get(key)
+        if jitted is None:
+            def fn(b):
+                for nd in reversed(chain):
+                    b = self._dispatch_apply(nd, b)
+                return b
+            jitted = jax.jit(fn)
+            self._jit_chains[key] = jitted
+        return jitted(base)
 
     # ------------------------------------------------------------------
     # leaves
@@ -287,6 +295,25 @@ class Executor:
         cols = dict(src.columns)
         cols[node.marker] = Column(BOOLEAN, marker, None)
         return Batch(cols, src.num_rows)
+
+    def _exec_GroupIdNode(self, node) -> Batch:
+        """plan/GroupIdNode.java: one copy of the input per grouping set;
+        keys absent from a set become NULL; id column tags the set."""
+        src = self.execute(node.source)
+        copies = []
+        for i, keys in enumerate(node.grouping_sets):
+            keep = set(keys)
+            cols = {}
+            for s, c in src.columns.items():
+                if s in node.all_keys and s not in keep:
+                    cols[s] = dc_replace(
+                        c, valid=jnp.zeros((c.capacity,), bool))
+                else:
+                    cols[s] = c
+            cols[node.id_symbol] = Column(
+                BIGINT, jnp.full((src.capacity,), i, jnp.int64), None)
+            copies.append(Batch(cols, src.num_rows))
+        return device_concat(copies)
 
     # ------------------------------------------------------------------
     # joins

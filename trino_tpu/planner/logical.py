@@ -414,11 +414,10 @@ class LogicalPlanner:
     def _plan_aggregation(self, spec, agg_calls, ctx, select_items):
         # 1. group keys planned against the pre-agg scope
         group_exprs: List[A.Expression] = []
+        grouping_sets: List[Tuple[int, ...]] = [()]
         if spec.group_by:
-            if len(spec.group_by.sets) != 1:
-                raise PlanningError(
-                    "GROUPING SETS/CUBE/ROLLUP not yet supported")
             group_exprs = list(spec.group_by.exprs)
+            grouping_sets = list(spec.group_by.sets)
         # resolve ordinals / aliases in GROUP BY (SQL allows ordinals)
         resolved_groups: List[A.Expression] = []
         for g in group_exprs:
@@ -486,14 +485,47 @@ class LogicalPlanner:
             full.update(pre_assigns)
             root = ProjectNode(root, full)
 
-        agg_node = AggregationNode(root, tuple(dict.fromkeys(key_syms)),
-                                   aggregates)
+        group_key_tuple = tuple(dict.fromkeys(key_syms))
+        id_sym = None
+        if len(grouping_sets) > 1:
+            # GROUPING SETS / ROLLUP / CUBE: replicate rows per set with
+            # a set-id column (plan/GroupIdNode.java). Aggregate
+            # arguments/masks that coincide with grouping keys must read
+            # a COPY of the column — GroupId nulls the key lanes in
+            # subtotal copies but the aggregates see the original values
+            # (the reference keeps separate argument mappings for this).
+            from ..plan.nodes import GroupIdNode
+            arg_copies: Dict[str, str] = {}
+            new_aggs = {}
+            for out_sym, a in aggregates.items():
+                upd = {}
+                for field_name in ("argument", "mask"):
+                    s = getattr(a, field_name)
+                    if s is not None and s in group_key_tuple:
+                        if s not in arg_copies:
+                            arg_copies[s] = self.symbols.new(s + "_arg")
+                        upd[field_name] = arg_copies[s]
+                new_aggs[out_sym] = dc_replace(a, **upd) if upd else a
+            aggregates = new_aggs
+            if arg_copies:
+                schema = root.output_schema()
+                full = {s: InputRef(s, t) for s, t in schema.items()}
+                for orig, copy in arg_copies.items():
+                    full[copy] = InputRef(orig, schema[orig])
+                root = ProjectNode(root, full)
+            id_sym = self.symbols.new("groupid")
+            # grouping sets index into group_exprs; map to symbols
+            expr_syms = [key_map[g] for g in resolved_groups]
+            set_syms = tuple(
+                tuple(dict.fromkeys(expr_syms[i] for i in s))
+                for s in grouping_sets)
+            root = GroupIdNode(root, set_syms, group_key_tuple, id_sym)
+            group_key_tuple = group_key_tuple + (id_sym,)
+
+        agg_node = AggregationNode(root, group_key_tuple, aggregates,
+                                   group_id_symbol=id_sym)
         agg_node = self._rewrite_distinct_aggregation(agg_node)
 
-        post_scope = Scope(
-            [Field(None, s, t)
-             for s, t in agg_node.output_schema().items()],
-            ctx.scope.outer)
         post = _ExprContext(self, ctx.scope, agg_node,
                             agg_map=agg_map, key_map=key_map,
                             group_symbols=set(agg_node.group_keys))
@@ -628,6 +660,8 @@ class LogicalPlanner:
                 fields.append(Field(name, f.symbol, f.type, parts[0]))
             return RelationPlan(rp.root, Scope(fields, outer))
         catalog, schema, table = self._qualify(parts)
+        if schema == "information_schema":
+            return self._plan_information_schema(catalog, table, outer)
         handle, meta = self.catalogs.resolve_table(catalog, schema, table)
         assignments, schema_map, fields = {}, {}, []
         for cm in meta.columns:
@@ -638,6 +672,49 @@ class LogicalPlanner:
                                 table.lower()))
         return RelationPlan(TableScanNode(handle, assignments, schema_map),
                             Scope(fields, outer))
+
+    def _plan_information_schema(self, catalog: str, table: str,
+                                 outer) -> RelationPlan:
+        """information_schema synthesized from connector metadata at plan
+        time (reference: connector/informationschema/ — a virtual
+        connector per catalog)."""
+        conn = self.catalogs.connector(catalog)
+        if table == "schemata":
+            cols = [("catalog_name", VARCHAR), ("schema_name", VARCHAR)]
+            rows = [(catalog, s) for s in conn.list_schemas()]
+        elif table == "tables":
+            cols = [("table_catalog", VARCHAR), ("table_schema", VARCHAR),
+                    ("table_name", VARCHAR), ("table_type", VARCHAR)]
+            rows = [(catalog, s, t, "BASE TABLE")
+                    for s in conn.list_schemas()
+                    for t in conn.list_tables(s)]
+        elif table == "columns":
+            cols = [("table_catalog", VARCHAR), ("table_schema", VARCHAR),
+                    ("table_name", VARCHAR), ("column_name", VARCHAR),
+                    ("ordinal_position", BIGINT),
+                    ("column_default", VARCHAR),
+                    ("is_nullable", VARCHAR), ("data_type", VARCHAR)]
+            rows = []
+            for s in conn.list_schemas():
+                for t in conn.list_tables(s):
+                    meta = conn.get_table_metadata(s, t)
+                    for i, cm in enumerate(meta.columns):
+                        rows.append((catalog, s, t, cm.name, i + 1,
+                                     None, "YES", cm.type.name))
+        elif table == "views":
+            cols = [("table_catalog", VARCHAR), ("table_schema", VARCHAR),
+                    ("table_name", VARCHAR), ("view_definition", VARCHAR)]
+            rows = []
+        else:
+            raise PlanningError(
+                f"Table '{catalog}.information_schema.{table}' does not "
+                "exist")
+        syms = [self.symbols.new(n) for n, _ in cols]
+        schema_map = {sym: ty for sym, (_, ty) in zip(syms, cols)}
+        node = ValuesNode(schema_map, tuple(rows))
+        scope = Scope([Field(n, sym, ty, table)
+                       for sym, (n, ty) in zip(syms, cols)], outer)
+        return RelationPlan(node, scope)
 
     def _qualify(self, parts: Tuple[str, ...]):
         if len(parts) == 3:
@@ -758,8 +835,41 @@ class LogicalPlanner:
                 "IN subquery must return exactly one column")
         corr = _correlated_symbols(sub.root, _all_symbols(ctx.root))
         if corr:
-            raise PlanningError(
-                "correlated IN subqueries not yet supported")
+            if negated:
+                # the null-unaware rewrite below would turn NULL into
+                # FALSE, which NOT inverts into spurious TRUE rows
+                raise PlanningError(
+                    "correlated NOT IN subqueries not supported")
+            # correlated IN -> EXISTS-style semi join on the correlation
+            # pairs plus (operand = subquery output). Null-unaware: where
+            # full IN semantics would yield NULL this yields FALSE —
+            # output-equivalent for a positive IN in WHERE
+            # (TransformCorrelatedInPredicateToJoin's non-null-aware
+            # branch in the reference).
+            f = sub.scope.fields[0]
+            t = common_super_type(operand.type, f.type)
+            if t is None:
+                raise PlanningError(
+                    f"IN: incompatible types {operand.type} / {f.type}")
+            new_root, pairs, residual = _decorrelate_exists(
+                sub.root, corr, self.symbols)
+            schema = new_root.output_schema()
+            filt_sym = f.symbol
+            if f.type != t:
+                filt_sym = self.symbols.new("inkey")
+                assigns = {s: InputRef(s, ty)
+                           for s, ty in schema.items()}
+                assigns[filt_sym] = Cast(InputRef(f.symbol, f.type), t)
+                new_root = ProjectNode(new_root, assigns)
+            src_sym = self._attach_symbol(ctx, _maybe_cast(operand, t))
+            src_keys = (src_sym,) + tuple(o for o, _ in pairs)
+            filt_keys = (filt_sym,) + tuple(i for _, i in pairs)
+            mark = self.symbols.new("insubquery")
+            ctx.root = SemiJoinMultiNode(
+                ctx.root, new_root, src_keys, filt_keys, residual, mark,
+                null_aware=False)
+            e2: RowExpr = InputRef(mark, BOOLEAN)
+            return Call("not", (e2,), BOOLEAN) if negated else e2
         f = sub.scope.fields[0]
         t = common_super_type(operand.type, f.type)
         if t is None:
@@ -808,6 +918,74 @@ class LogicalPlanner:
                 residual, mark, null_aware=False)
         e: RowExpr = InputRef(mark, BOOLEAN)
         return Call("not", (e,), BOOLEAN) if negated else e
+
+    def plan_quantified(self, ctx: "_ExprContext",
+                        e: A.QuantifiedComparison) -> RowExpr:
+        """x <op> ALL/ANY (subquery) — rewritten over (min/max, count,
+        count-non-null) of the subquery with full three-valued logic
+        (reference rules: QuantifiedComparison -> aggregation rewrite in
+        TransformQuantifiedComparisonApplyToCorrelatedJoin)."""
+        op = "<>" if e.op == "!=" else e.op
+        quant = e.quantifier.lower()
+        is_all = quant == "all"
+        if op == "=" and not is_all:
+            return self.plan_in_subquery(
+                ctx, self._rewrite_expr(e.operand, ctx), e.query, False)
+        if op == "<>" and is_all:
+            return self.plan_in_subquery(
+                ctx, self._rewrite_expr(e.operand, ctx), e.query, True)
+        if op in ("=", "<>"):
+            raise PlanningError(f"{op} {quant.upper()} not supported")
+        sub, _ = self.plan_query(e.query, outer=ctx.scope)
+        if len(sub.scope.fields) != 1:
+            raise PlanningError(
+                "quantified subquery must return exactly one column")
+        if _correlated_symbols(sub.root, _all_symbols(ctx.root)):
+            raise PlanningError(
+                "correlated quantified subqueries not supported")
+        operand = self._rewrite_expr(e.operand, ctx)
+        f = sub.scope.fields[0]
+        t = common_super_type(operand.type, f.type)
+        if t is None:
+            raise PlanningError(
+                f"{op} {quant}: incompatible types "
+                f"{operand.type} / {f.type}")
+        sub_root = sub.root
+        arg_sym = f.symbol
+        if f.type != t:
+            arg_sym = self.symbols.new("qarg")
+            sub_root = ProjectNode(
+                sub_root, {arg_sym: Cast(InputRef(f.symbol, f.type), t)})
+        # ALL with >/>= bounds against max; ANY against min (and
+        # symmetrically for </<=)
+        want_max = (op in (">", ">=")) == is_all
+        b_sym = self.symbols.new("bound")
+        n_sym = self.symbols.new("cnt")
+        nn_sym = self.symbols.new("cnt_nonnull")
+        agg = AggregationNode(sub_root, (), {
+            b_sym: Aggregate("max" if want_max else "min", arg_sym, t),
+            n_sym: Aggregate("count_star", None, BIGINT),
+            nn_sym: Aggregate("count", arg_sym, BIGINT)})
+        ctx.root = JoinNode(ctx.root, agg, "cross")
+        x = _maybe_cast(operand, t)
+        cmp = Call(op, (x, InputRef(b_sym, t)), BOOLEAN)
+        empty = Call("=", (InputRef(n_sym, BIGINT), Const(0, BIGINT)),
+                     BOOLEAN)
+        has_null = Call("<", (InputRef(nn_sym, BIGINT),
+                              InputRef(n_sym, BIGINT)), BOOLEAN)
+        if is_all:
+            # TRUE on empty; FALSE when the comparison fails against the
+            # bound; NULL when it holds but the set contains NULLs
+            return CaseExpr((
+                (empty, rex.TRUE),
+                (Call("not", (cmp,), BOOLEAN), rex.FALSE),
+                (has_null, Const(None, BOOLEAN))),
+                cmp, BOOLEAN)
+        return CaseExpr((
+            (empty, rex.FALSE),
+            (cmp, rex.TRUE),
+            (has_null, Const(None, BOOLEAN))),
+            cmp, BOOLEAN)
 
     def _attach_symbol(self, ctx: "_ExprContext", e: RowExpr) -> str:
         if isinstance(e, InputRef):
@@ -977,7 +1155,7 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
     if isinstance(e, A.ScalarSubquery):
         return self.plan_scalar_subquery(ctx, e.query)
     if isinstance(e, A.QuantifiedComparison):
-        raise PlanningError("ALL/ANY subqueries not yet supported")
+        return self.plan_quantified(ctx, e)
     if isinstance(e, A.Like):
         op = self._rewrite_expr(e.operand, ctx)
         pat = self._rewrite_expr(e.pattern, ctx)

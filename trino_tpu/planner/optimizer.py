@@ -32,8 +32,11 @@ from ..planner.logical import SemiJoinMultiNode
 from ..rex import Call, Const, InputRef, RowExpr, TRUE
 
 
-def optimize(plan: PlanNode) -> PlanNode:
+def optimize(plan: PlanNode, catalogs=None) -> PlanNode:
     plan = push_filters(plan)
+    if catalogs is not None:
+        from .stats import choose_join_sides
+        plan = choose_join_sides(plan, catalogs)
     plan = prune_columns(plan)
     plan = cleanup_projects(plan)
     return plan

@@ -1,0 +1,137 @@
+"""Cardinality estimation + cost-based join decisions.
+
+Reference parity: cost/ (45 files — StatsCalculator, FilterStatsCalculator,
+JoinStatsRule, CostCalculatorUsingExchanges) + the cost-based rules
+DetermineJoinDistributionType / ReorderJoins (SURVEY.md §2.1 "Stats &
+cost"). Round-1 scope: scan row counts from connector statistics
+(spi/statistics/TableStatistics analog), heuristic filter factors, and
+two decisions: (a) probe/build side selection — the hash build side
+should be the smaller input; (b) PARTITIONED vs REPLICATED distribution
+for the distributed executor.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+from typing import Optional
+
+from .. import rex
+from ..catalog import CatalogManager
+from ..plan.nodes import (AggregationNode, EnforceSingleRowNode,
+                          FilterNode, JoinClause, JoinNode, LimitNode,
+                          OffsetNode, PlanNode, ProjectNode, SampleNode,
+                          SemiJoinNode, SetOpNode, SortNode,
+                          TableScanNode, TopNNode, UnionNode, ValuesNode)
+from ..rex import Call, CaseExpr, Cast, Const, InputRef
+
+# filter selectivity heuristics (FilterStatsCalculator's defaults)
+_EQ_FACTOR = 0.05
+_RANGE_FACTOR = 0.35
+_LIKE_FACTOR = 0.25
+_OTHER_FACTOR = 0.5
+# REPLICATED below this build-side estimate (DetermineJoinDistributionType)
+BROADCAST_ROWS = 1_000_000.0
+
+
+def estimate_rows(node: PlanNode, catalogs: CatalogManager) -> float:
+    if isinstance(node, TableScanNode):
+        conn = catalogs.connector(node.handle.catalog)
+        est = conn.table_row_count(node.handle)
+        return float(est) if est is not None else 10_000.0
+    if isinstance(node, FilterNode):
+        return estimate_rows(node.source, catalogs) * \
+            _selectivity(node.predicate)
+    if isinstance(node, (ProjectNode, SortNode, SampleNode)):
+        return estimate_rows(node.sources[0], catalogs)
+    if isinstance(node, (LimitNode, TopNNode)):
+        return min(float(node.count),
+                   estimate_rows(node.sources[0], catalogs))
+    if isinstance(node, OffsetNode):
+        return max(estimate_rows(node.source, catalogs) - node.count, 0.0)
+    if isinstance(node, AggregationNode):
+        child = estimate_rows(node.source, catalogs)
+        if not node.group_keys:
+            return 1.0
+        return max(child * 0.1, 1.0)
+    if isinstance(node, JoinNode):
+        l = estimate_rows(node.left, catalogs)
+        r = estimate_rows(node.right, catalogs)
+        if node.join_type == "cross" and not node.criteria:
+            return l * r
+        if node.join_type == "left":
+            return max(l, 1.0)
+        # FK-join assumption: output ~ the larger side
+        return max(l, r)
+    if isinstance(node, SemiJoinNode):
+        return estimate_rows(node.source, catalogs)
+    if isinstance(node, EnforceSingleRowNode):
+        return 1.0
+    if isinstance(node, ValuesNode):
+        return float(len(node.rows))
+    if isinstance(node, UnionNode):
+        return sum(estimate_rows(c, catalogs) for c in node.children)
+    if isinstance(node, SetOpNode):
+        return estimate_rows(node.left, catalogs)
+    if node.sources:
+        return estimate_rows(node.sources[0], catalogs)
+    return 1_000.0
+
+
+def _selectivity(e) -> float:
+    factor = 1.0
+    for c in rex.split_conjuncts(e):
+        if isinstance(c, Call):
+            if c.fn == "=":
+                factor *= _EQ_FACTOR
+            elif c.fn in ("<", "<=", ">", ">="):
+                factor *= _RANGE_FACTOR
+            elif c.fn == "like":
+                factor *= _LIKE_FACTOR
+            elif c.fn == "or":
+                factor *= min(_OTHER_FACTOR * 1.5, 1.0)
+            else:
+                factor *= _OTHER_FACTOR
+        else:
+            factor *= _OTHER_FACTOR
+    return max(factor, 1e-4)
+
+
+def choose_join_sides(node: PlanNode,
+                      catalogs: CatalogManager) -> PlanNode:
+    """Make the smaller input the hash-build (right) side and pick the
+    exchange distribution. Inner equi-joins only — outer joins keep
+    their probe side (the executor flips RIGHT joins itself)."""
+    if isinstance(node, JoinNode):
+        left = choose_join_sides(node.left, catalogs)
+        right = choose_join_sides(node.right, catalogs)
+        node = dc_replace(node, left=left, right=right)
+        if node.join_type == "inner" and node.criteria:
+            l_est = estimate_rows(node.left, catalogs)
+            r_est = estimate_rows(node.right, catalogs)
+            if l_est < r_est:
+                node = JoinNode(
+                    node.right, node.left, "inner",
+                    tuple(JoinClause(c.right, c.left)
+                          for c in node.criteria),
+                    node.filter, node.distribution)
+                l_est, r_est = r_est, l_est
+            dist = ("replicated" if r_est <= BROADCAST_ROWS
+                    else "partitioned")
+            node = dc_replace(node, distribution=dist)
+        return node
+    if not node.sources:
+        return node
+    import dataclasses
+    if dataclasses.is_dataclass(node):
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, PlanNode):
+                updates[f.name] = choose_join_sides(v, catalogs)
+            elif isinstance(v, tuple) and v and all(
+                    isinstance(x, PlanNode) for x in v):
+                updates[f.name] = tuple(
+                    choose_join_sides(x, catalogs) for x in v)
+        if updates:
+            return dc_replace(node, **updates)
+    return node

@@ -13,7 +13,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .catalog import (CatalogManager, ColumnMetadata, TableMetadata)
+from .catalog import (CatalogManager, ColumnMetadata, TableHandle,
+                      TableMetadata)
 from .columnar import Batch, batch_from_pylist
 from .connectors.memory import BlackholeConnector, MemoryConnector
 from .connectors.tpch import TpchConnector
@@ -106,7 +107,7 @@ class LocalQueryRunner:
             raise QueryError("only queries can be planned")
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = planner.plan(stmt)
-        return optimize(plan) if optimized else plan
+        return optimize(plan, self.catalogs) if optimized else plan
 
     # ------------------------------------------------------------------
     def _dispatch(self, stmt: A.Statement) -> QueryResult:
@@ -186,7 +187,7 @@ class LocalQueryRunner:
         if isinstance(stmt, A.Insert):
             return self._insert(stmt)
         if isinstance(stmt, A.Delete):
-            raise QueryError("DELETE not yet supported")
+            return self._delete(stmt)
         raise QueryError(
             f"statement {type(stmt).__name__} not supported")
 
@@ -195,7 +196,7 @@ class LocalQueryRunner:
                    collect_stats: bool = False):
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = planner.plan(stmt)
-        plan = optimize(plan)
+        plan = optimize(plan, self.catalogs)
         ex = self._make_executor(collect_stats)
         batch = ex.execute(plan)
         schema = batch.schema()
@@ -211,7 +212,7 @@ class LocalQueryRunner:
         if not isinstance(inner, A.QueryStatement):
             raise QueryError("EXPLAIN supports queries only")
         planner = LogicalPlanner(self.catalogs, self.session)
-        plan = optimize(planner.plan(inner))
+        plan = optimize(planner.plan(inner), self.catalogs)
         if stmt.analyze:
             res = self._run_query(inner, collect_stats=True)
             lines = plan_tree_lines(plan)
@@ -270,6 +271,41 @@ class LocalQueryRunner:
         batch = batch_from_pylist(data, schema_map)
         n = conn.insert(schema, table, batch)
         return _msg_result("INSERT", n)
+
+    def _delete(self, stmt: A.Delete) -> QueryResult:
+        """DELETE as survivor rewrite (reference: plan/TableDeleteNode +
+        connector delete; the memory connector swaps contents)."""
+        cat, schema, table = self._qualify(stmt.table)
+        conn = self.catalogs.connector(cat)
+        meta = conn.get_table_metadata(schema, table)
+        if meta is None:
+            raise QueryError(
+                f"Table '{cat}.{schema}.{table}' does not exist")
+        if not hasattr(conn, "replace"):
+            raise QueryError(f"{conn.name}: DELETE not supported")
+        total = conn.table_row_count(
+            TableHandle(cat, schema, table)) or 0
+        if stmt.where is None:
+            from .columnar import empty_batch
+            conn.replace(schema, table, empty_batch(
+                {c.name: c.type for c in meta.columns}))
+            return _msg_result("DELETE", int(total))
+        # survivors: rows where the predicate is not TRUE (3VL)
+        survivors = self._run_query(A.QueryStatement(A.Query(
+            A.QuerySpecification(
+                tuple(A.SelectItem(A.Identifier((c.name,)), c.name)
+                      for c in meta.columns),
+                from_=A.Table((cat, schema, table)),
+                where=A.UnaryOp(
+                    "not", A.FunctionCall(
+                        "coalesce", (stmt.where,
+                                     A.Literal(False))))))))
+        data = {c.name: [row[i] for row in survivors.rows]
+                for i, c in enumerate(meta.columns)}
+        batch = batch_from_pylist(
+            data, {c.name: c.type for c in meta.columns})
+        conn.replace(schema, table, batch)
+        return _msg_result("DELETE", int(total) - len(survivors.rows))
 
     def _qualify(self, parts: Tuple[str, ...]):
         parts = tuple(p.lower() for p in parts)
