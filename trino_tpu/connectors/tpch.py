@@ -128,8 +128,18 @@ _COMMENT_WORDS = (
     "among across above against along until again after about the")
 
 
+_DICT_CACHE: Dict[tuple, StringDictionary] = {}
+
+
 def _strings(values: Sequence[str], codes: np.ndarray, typ: Type) -> Column:
-    d = StringDictionary(np.asarray(list(values), dtype=object))
+    # fixed-vocabulary dictionaries are shared by identity across splits
+    # so jitted pipelines (dictionary is static trace metadata) compile
+    # once per query, not once per split
+    key = tuple(values)
+    d = _DICT_CACHE.get(key)
+    if d is None:
+        d = StringDictionary(np.asarray(list(values), dtype=object))
+        _DICT_CACHE[key] = d
     return Column(typ, codes.astype(np.int32), None, d)
 
 

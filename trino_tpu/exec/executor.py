@@ -92,6 +92,10 @@ class Executor:
         return out
 
     def _execute_inner(self, node: PlanNode) -> Batch:
+        if isinstance(node, AggregationNode):
+            streamed = self._try_streaming_aggregation(node)
+            if streamed is not None:
+                return streamed
         if self.fragment_jit and isinstance(node, _TRACEABLE):
             chain = []
             cur = node
@@ -120,6 +124,82 @@ class Executor:
             return method(node)
         except EvalError as e:
             raise QueryError(str(e)) from e
+
+    # ------------------------------------------------------------------
+    # streaming aggregation over scan splits (grouped execution analog:
+    # execution/Lifespan.java + SpillableHashAggregationBuilder — bound
+    # memory by aggregating split-by-split with one compiled program,
+    # then combining partials)
+    # ------------------------------------------------------------------
+    _STREAM_CHAIN = None   # set after class body
+
+    def _try_streaming_aggregation(self, node: AggregationNode):
+        chain = []
+        cur = node.source
+        while isinstance(cur, self._STREAM_CHAIN):
+            chain.append(cur)
+            cur = cur.source
+        if not isinstance(cur, TableScanNode):
+            return None
+        conn = self.catalogs.connector(cur.handle.catalog)
+        splits = conn.get_splits(cur.handle,
+                                 int(self.session.get("task_concurrency"))
+                                 or 1)
+        if len(splits) < 2:
+            return None
+        columns = sorted(set(cur.assignments.values()))
+        partials: List[Batch] = []
+        phys = post = None
+
+        def run(b: Batch) -> Batch:
+            for nd in reversed(chain):
+                b = self._dispatch_apply(nd, b)
+            _p, _post, extra = _lower_aggregates(node.aggregates, b)
+            if extra:
+                cols = dict(b.columns)
+                cols.update(extra)
+                b = Batch(cols, b.num_rows)
+            if node.group_keys:
+                return group_aggregate(b, list(node.group_keys), _p)
+            return _pad_partial(global_aggregate(b, _p))
+
+        # one jitted program serves every split (uniform capacities)
+        run_jit = jax.jit(run) if self.fragment_jit else None
+        for sp in splits:
+            raw = conn.read_split(sp, columns)
+            batch = Batch({sym: raw.column(col)
+                           for sym, col in cur.assignments.items()},
+                          raw.num_rows)
+            if phys is None:
+                phys, post, _ = _lower_aggregates(node.aggregates, batch)
+            if run_jit is not None:
+                try:
+                    out = run_jit(batch)
+                except (jax.errors.TracerArrayConversionError,
+                        jax.errors.ConcretizationTypeError):
+                    run_jit = None
+                    out = run(batch)
+            else:
+                out = run(batch)
+            partials.append(out)
+        merged = device_concat(partials)
+        finals = [AggInput(
+            {"sum": "sum", "count": "sum", "count_star": "sum",
+             "min": "min", "max": "max",
+             "any_value": "any_value"}[a.kind], a.output, None, a.output)
+            for a in phys]
+        if node.group_keys:
+            out = group_aggregate(merged, list(node.group_keys), finals)
+        else:
+            out = global_aggregate(merged, finals)
+        if post:
+            cols = dict(out.columns)
+            for sym, fn in post.items():
+                cols[sym] = fn(out)
+            keep = set(node.group_keys) | set(node.aggregates)
+            cols = {s: c for s, c in cols.items() if s in keep}
+            out = Batch(cols, out.num_rows)
+        return out
 
     def _dispatch_apply(self, node: PlanNode, src: Batch) -> Batch:
         try:
@@ -561,6 +641,22 @@ class Executor:
 _TRACEABLE = (FilterNode, ProjectNode, LimitNode, OffsetNode, SortNode,
               TopNNode, SampleNode, AssignUniqueIdNode, MarkDistinctNode,
               AggregationNode)
+Executor._STREAM_CHAIN = (FilterNode, ProjectNode, SampleNode)
+
+
+def _pad_partial(b: Batch) -> Batch:
+    """Pad a 1-row global-aggregate partial to capacity 8 so partials
+    from every split concatenate uniformly."""
+    cols = {}
+    for s, c in b.columns.items():
+        data = jnp.pad(jnp.asarray(c.data), (0, 8 - c.capacity))
+        valid = (None if c.valid is None
+                 else jnp.pad(jnp.asarray(c.valid), (0, 8 - c.capacity)))
+        cols[s] = Column(c.type, data, valid, c.dictionary,
+                         None if c.data2 is None else
+                         jnp.pad(jnp.asarray(c.data2),
+                                 (0, 8 - c.capacity)))
+    return Batch(cols, b.num_rows)
 
 
 def _flip_clause(c):
