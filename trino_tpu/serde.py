@@ -1,0 +1,277 @@
+"""Columnar page wire/spill format.
+
+Reference parity: execution/buffer/{PagesSerde.java:41-74,
+SerializedPage.java:25-47, PagesSerdeUtil.java:64-100, PageCodecMarker}
+— header + per-block encodings, optional LZ4, checksum. TPU-first frame:
+struct-of-arrays (one contiguous lane per column — uploads straight into
+device buffers), little-endian, xxHash64 trailer. The LZ4/xxh64 hot
+loops are native C++ (native/pageserde.cpp) loaded via ctypes; a
+pure-python "store" codec keeps everything working when the library
+hasn't been built.
+
+Frame layout:
+  magic 'TPG1' | u8 codec | u32 ncols | u64 nrows
+  per column:
+    u16 name_len | name utf8 | u16 type_len | type utf8 | u8 flags
+    lane DATA  [flags&1: VALID lane] [flags&2: DATA2 lane]
+    [flags&4: dictionary — u32 count | per value u32 len + utf8]
+  u64 xxh64 of everything before the trailer
+Each lane: u8 dtype_code | u64 raw_len | u64 stored_len | bytes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+import subprocess
+from typing import Dict, Optional
+
+import numpy as np
+
+from .columnar import Batch, Column, StringDictionary
+from .config import capacity_for
+from .types import Type, parse_type
+
+_MAGIC = b"TPG1"
+CODEC_STORE = 0
+CODEC_LZ4 = 1
+
+_DTYPES = [np.dtype(x) for x in
+           ("bool", "int8", "int16", "int32", "int64", "float32",
+            "float64", "uint64")]
+_DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
+
+
+# --------------------------------------------------------------------------
+# native library loading (build on demand, cache the result)
+# --------------------------------------------------------------------------
+
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_TRIED = False
+
+
+def _load_native() -> Optional[ctypes.CDLL]:
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    _LIB_TRIED = True
+    here = os.path.dirname(os.path.abspath(__file__))
+    so = os.path.join(here, "native", "libpageserde.so")
+    src = os.path.normpath(os.path.join(here, "..", "native",
+                                        "pageserde.cpp"))
+    if not os.path.exists(so) and os.path.exists(src):
+        try:
+            os.makedirs(os.path.dirname(so), exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-fPIC", "-shared", "-std=c++17",
+                 "-o", so, src],
+                check=True, capture_output=True, timeout=120)
+        except (OSError, subprocess.SubprocessError):
+            return None
+    if not os.path.exists(so):
+        return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError:
+        return None
+    for name, restype, argtypes in [
+        ("tt_lz4_compress", ctypes.c_int64,
+         [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+          ctypes.c_int64]),
+        ("tt_lz4_decompress", ctypes.c_int64,
+         [ctypes.c_char_p, ctypes.c_int64, ctypes.c_char_p,
+          ctypes.c_int64]),
+        ("tt_lz4_max_compressed", ctypes.c_int64, [ctypes.c_int64]),
+        ("tt_xxh64", ctypes.c_uint64,
+         [ctypes.c_char_p, ctypes.c_int64, ctypes.c_uint64]),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
+    _LIB = lib
+    return _LIB
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def checksum(data: bytes, seed: int = 0) -> int:
+    lib = _load_native()
+    if lib is not None:
+        return int(lib.tt_xxh64(data, len(data), seed))
+    import zlib
+    return zlib.crc32(data) ^ (seed & 0xFFFFFFFF)   # python fallback
+
+
+def _compress(data: bytes, codec: int) -> bytes:
+    if codec == CODEC_LZ4:
+        lib = _load_native()
+        cap = int(lib.tt_lz4_max_compressed(len(data)))
+        out = ctypes.create_string_buffer(cap)
+        n = lib.tt_lz4_compress(data, len(data), out, cap)
+        if n < 0:
+            raise ValueError("lz4 compression failed")
+        return out.raw[:n]
+    return data
+
+
+def _decompress(data: bytes, raw_len: int, codec: int) -> bytes:
+    if codec == CODEC_LZ4:
+        lib = _load_native()
+        out = ctypes.create_string_buffer(raw_len)
+        n = lib.tt_lz4_decompress(data, len(data), out, raw_len)
+        if n != raw_len:
+            raise ValueError(
+                f"lz4 decompression failed ({n} != {raw_len})")
+        return out.raw
+    return data
+
+
+# --------------------------------------------------------------------------
+# framing
+# --------------------------------------------------------------------------
+
+def _emit_lane(out: list, arr: np.ndarray, codec: int):
+    arr = np.ascontiguousarray(arr)
+    code = _DTYPE_CODE[arr.dtype]
+    raw = arr.tobytes()
+    stored = _compress(raw, codec)
+    if len(stored) >= len(raw):
+        stored, lane_codec = raw, CODEC_STORE
+    else:
+        lane_codec = codec
+    out.append(struct.pack("<BBQQ", code, lane_codec, len(raw),
+                           len(stored)))
+    out.append(stored)
+
+
+def _read_lane(buf: memoryview, off: int):
+    code, lane_codec, raw_len, stored_len = struct.unpack_from(
+        "<BBQQ", buf, off)
+    off += struct.calcsize("<BBQQ")
+    stored = bytes(buf[off:off + stored_len])
+    off += stored_len
+    raw = _decompress(stored, raw_len, lane_codec)
+    return np.frombuffer(raw, dtype=_DTYPES[code]).copy(), off
+
+
+def serialize_batch(batch: Batch, codec: Optional[int] = None) -> bytes:
+    """Batch -> framed bytes (live prefix only)."""
+    if codec is None:
+        codec = CODEC_LZ4 if native_available() else CODEC_STORE
+    n = batch.num_rows_host()
+    out: list = [_MAGIC, struct.pack("<BIQ", codec,
+                                     len(batch.columns), n)]
+    for name, col in batch.columns.items():
+        nb = name.encode()
+        tb = col.type.name.encode()
+        flags = ((1 if col.valid is not None else 0)
+                 | (2 if col.data2 is not None else 0)
+                 | (4 if col.dictionary is not None else 0))
+        out.append(struct.pack("<H", len(nb)))
+        out.append(nb)
+        out.append(struct.pack("<H", len(tb)))
+        out.append(tb)
+        out.append(struct.pack("<B", flags))
+        _emit_lane(out, np.asarray(col.data)[:n], codec)
+        if col.valid is not None:
+            _emit_lane(out, np.asarray(col.valid)[:n], codec)
+        if col.data2 is not None:
+            _emit_lane(out, np.asarray(col.data2)[:n], codec)
+        if col.dictionary is not None:
+            vals = col.dictionary.values
+            out.append(struct.pack("<I", len(vals)))
+            for v in vals:
+                vb = str(v).encode()
+                out.append(struct.pack("<I", len(vb)))
+                out.append(vb)
+    body = b"".join(out)
+    return body + struct.pack("<Q", checksum(body))
+
+
+def deserialize_batch(data: bytes) -> Batch:
+    buf = memoryview(data)
+    body, (csum,) = buf[:-8], struct.unpack_from("<Q", buf, len(buf) - 8)
+    if checksum(bytes(body)) != csum:
+        raise ValueError("page checksum mismatch")
+    if bytes(buf[:4]) != _MAGIC:
+        raise ValueError("bad page magic")
+    codec, ncols, nrows = struct.unpack_from("<BIQ", buf, 4)
+    off = 4 + struct.calcsize("<BIQ")
+    cols: Dict[str, Column] = {}
+    cap = capacity_for(max(int(nrows), 1), minimum=8)
+    for _ in range(ncols):
+        (nlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        name = bytes(buf[off:off + nlen]).decode()
+        off += nlen
+        (tlen,) = struct.unpack_from("<H", buf, off)
+        off += 2
+        typ = parse_type(bytes(buf[off:off + tlen]).decode())
+        off += tlen
+        (flags,) = struct.unpack_from("<B", buf, off)
+        off += 1
+        data_arr, off = _read_lane(buf, off)
+        valid = d2 = dictionary = None
+        if flags & 1:
+            valid, off = _read_lane(buf, off)
+        if flags & 2:
+            d2, off = _read_lane(buf, off)
+        if flags & 4:
+            (cnt,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            vals = []
+            for _ in range(cnt):
+                (vlen,) = struct.unpack_from("<I", buf, off)
+                off += 4
+                vals.append(bytes(buf[off:off + vlen]).decode())
+                off += vlen
+            dictionary = StringDictionary(np.asarray(vals, dtype=object))
+        pad = cap - len(data_arr)
+        data_arr = np.pad(data_arr, (0, pad))
+        if valid is not None:
+            valid = np.pad(valid, (0, pad))
+        if d2 is not None:
+            d2 = np.pad(d2, (0, pad))
+        cols[name] = Column(typ, data_arr, valid, dictionary, d2)
+    return Batch(cols, int(nrows))
+
+
+# --------------------------------------------------------------------------
+# spill (spiller/FileSingleStreamSpiller.java analog)
+# --------------------------------------------------------------------------
+
+class Spiller:
+    """Writes batches to local disk pages and reads them back — the
+    HBM -> host-RAM -> disk overflow tier (SURVEY.md §5
+    checkpoint/resume: spill/unspill is the reference's only
+    state-offload mechanism)."""
+
+    def __init__(self, directory: Optional[str] = None):
+        import tempfile
+        self._dir = directory or tempfile.mkdtemp(prefix="trino_tpu_spill_")
+        self._files: list = []
+
+    def spill(self, batch: Batch) -> str:
+        path = os.path.join(self._dir, f"page_{len(self._files)}.bin")
+        with open(path, "wb") as f:
+            f.write(serialize_batch(batch))
+        self._files.append(path)
+        return path
+
+    def unspill(self, path: str) -> Batch:
+        with open(path, "rb") as f:
+            return deserialize_batch(f.read())
+
+    def unspill_all(self):
+        return [self.unspill(p) for p in self._files]
+
+    def close(self):
+        for p in self._files:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+        self._files.clear()
