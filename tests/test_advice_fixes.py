@@ -1,0 +1,135 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md).
+
+Each test is the advisor's own repro. Reference semantics:
+LookupJoinOperator/NestedLoopJoinOperator outer handling,
+iterative/rule/ImplementExceptAll.java, operator/window/NTileFunction +
+LagFunction/LeadFunction argument handling.
+"""
+
+import pytest
+
+from trino_tpu.exec import QueryError
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def _sorted(rows):
+    return sorted(rows, key=lambda r: tuple(
+        (v is None, v) for v in r))
+
+
+def test_left_join_non_equi_only(runner):
+    res = runner.execute(
+        "SELECT * FROM (VALUES 1,2,3) t(x) "
+        "LEFT JOIN (VALUES 2) u(y) ON t.x < u.y")
+    assert _sorted(res.rows) == [[1, 2], [2, None], [3, None]]
+
+
+def test_right_join_non_equi_only(runner):
+    res = runner.execute(
+        "SELECT * FROM (VALUES 2) u(y) "
+        "RIGHT JOIN (VALUES 1,2,3) t(x) ON t.x < u.y")
+    assert _sorted(res.rows) == [[2, 1], [None, 2], [None, 3]]
+
+
+def test_full_join_non_equi_only(runner):
+    res = runner.execute(
+        "SELECT * FROM (VALUES 1,2) t(x) "
+        "FULL JOIN (VALUES 2,3) u(y) ON t.x > u.y")
+    # only match: x=... none? 1>2 F, 1>3 F, 2>2 F... no wait 2>... none
+    # matches: x>y pairs: none (2>2 false). All null-extended both ways.
+    assert _sorted(res.rows) == [[1, None], [2, None],
+                                 [None, 2], [None, 3]]
+
+
+def test_except_all_multiplicity(runner):
+    res = runner.execute(
+        "(SELECT * FROM (VALUES 1,1,1,2) t(x)) "
+        "EXCEPT ALL (SELECT * FROM (VALUES 1) u(x))")
+    assert _sorted(res.rows) == [[1], [1], [2]]
+
+
+def test_except_distinct_unchanged(runner):
+    res = runner.execute(
+        "(SELECT * FROM (VALUES 1,1,2) t(x)) "
+        "EXCEPT (SELECT * FROM (VALUES 1) u(x))")
+    assert res.rows == [[2]]
+
+
+def test_intersect_all_multiplicity(runner):
+    res = runner.execute(
+        "(SELECT * FROM (VALUES 1,1,1,2) t(x)) "
+        "INTERSECT ALL (SELECT * FROM (VALUES 1,1,3) u(x))")
+    assert _sorted(res.rows) == [[1], [1]]
+
+
+def test_full_join_residual_filter(runner):
+    res = runner.execute(
+        "SELECT * FROM (VALUES 1,2) t(x) "
+        "FULL JOIN (VALUES 1,3) u(y) ON x = y AND x > 5")
+    assert _sorted(res.rows) == [[1, None], [2, None],
+                                 [None, 1], [None, 3]]
+
+
+def test_left_join_residual_all_filtered(runner):
+    res = runner.execute(
+        "SELECT * FROM (VALUES 1,2) t(x) "
+        "LEFT JOIN (VALUES 1,3) u(y) ON x = y AND x > 5")
+    assert _sorted(res.rows) == [[1, None], [2, None]]
+
+
+def test_ntile_argument(runner):
+    res = runner.execute(
+        "SELECT x, ntile(2) OVER (ORDER BY x) FROM "
+        "(VALUES 1,2,3,4) t(x) ORDER BY x")
+    assert res.rows == [[1, 1], [2, 1], [3, 2], [4, 2]]
+    res = runner.execute(
+        "SELECT x, ntile(3) OVER (ORDER BY x) FROM "
+        "(VALUES 1,2,3,4,5) t(x) ORDER BY x")
+    assert res.rows == [[1, 1], [2, 1], [3, 2], [4, 2], [5, 3]]
+
+
+def test_lag_lead_offset_and_default(runner):
+    res = runner.execute(
+        "SELECT x, lag(x, 2) OVER (ORDER BY x), "
+        "lead(x, 2) OVER (ORDER BY x) FROM "
+        "(VALUES 1,2,3,4) t(x) ORDER BY x")
+    assert res.rows == [[1, None, 3], [2, None, 4],
+                        [3, 1, None], [4, 2, None]]
+    res = runner.execute(
+        "SELECT x, lag(x, 1, -1) OVER (ORDER BY x) FROM "
+        "(VALUES 1,2,3) t(x) ORDER BY x")
+    assert res.rows == [[1, -1], [2, 1], [3, 2]]
+
+
+def test_lag_default_offset_still_one(runner):
+    res = runner.execute(
+        "SELECT x, lag(x) OVER (ORDER BY x) FROM "
+        "(VALUES 10,20,30) t(x) ORDER BY x")
+    assert res.rows == [[10, None], [20, 10], [30, 20]]
+
+
+def test_lag_null_offset_gives_null(runner):
+    res = runner.execute(
+        "SELECT x, lag(x, y) OVER (ORDER BY x) FROM "
+        "(VALUES (1, 1), (2, CAST(NULL AS BIGINT)), (3, 1)) t(x, y) "
+        "ORDER BY x")
+    assert res.rows == [[1, None], [2, None], [3, 2]]
+
+
+def test_ntile_more_buckets_than_rows(runner):
+    res = runner.execute(
+        "SELECT x, ntile(8) OVER (ORDER BY x) FROM "
+        "(VALUES 1,2,3,4) t(x) ORDER BY x")
+    assert res.rows == [[1, 1], [2, 2], [3, 3], [4, 4]]
+
+
+def test_lag_string_default(runner):
+    res = runner.execute(
+        "SELECT x, lag(s, 1, 'none') OVER (ORDER BY x) FROM "
+        "(VALUES (1, 'a'), (2, 'b')) t(x, s) ORDER BY x")
+    assert res.rows == [[1, "none"], [2, "a"]]

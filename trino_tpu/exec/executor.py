@@ -61,6 +61,7 @@ class NodeStats:
 # "one bytecode class per pipeline" (ExpressionCompiler) as jax.jit
 # (SURVEY.md §7.2)
 _TRACEABLE = ()  # filled after class definition
+_PPOS, _BPOS = "__probe_pos$", "__build_pos$"
 
 
 class Executor:
@@ -411,8 +412,7 @@ class Executor:
         right = self.execute(node.right)
 
         if jt == "cross" or not node.criteria:
-            return self._cross_join(left, right, node.filter,
-                                    outer=(jt == "left"))
+            return self._cross_join(left, right, node.filter, jt)
 
         pkeys = [c.left for c in node.criteria]
         bkeys = [c.right for c in node.criteria]
@@ -432,52 +432,73 @@ class Executor:
                 out = self._append_right_unmatched(
                     out, left, right, pkeys, bkeys)
             return out
-        # residual filter: expand as inner candidates + probe position
-    # track, filter, then repair left-join missing rows
-        ppos = "__probe_pos$"
-        lcols = dict(left.columns)
-        lcols[ppos] = Column(BIGINT,
-                             jnp.arange(left.capacity, dtype=jnp.int64),
-                             None)
-        probe = Batch(lcols, left.num_rows)
+        # residual filter: expand as inner candidates with probe+build
+        # position tracks, filter, then repair unmatched outer rows from
+        # the *surviving* match sets (key-only counts are not enough —
+        # a key match rejected by the filter must still null-extend)
+        probe = self._with_pos(left, _PPOS) if jt in ("left", "full") \
+            else left
+        build = self._with_pos(right, _BPOS) if jt == "full" else right
         start, count, order = join_ops.match_counts(
-            probe, right, pkeys, bkeys)
+            probe, build, pkeys, bkeys)
         total = int(jnp.sum(count))
         cap = capacity_for(total)
-        cand = join_ops.expand_join(probe, right, start, count, order,
+        cand = join_ops.expand_join(probe, build, start, count, order,
                                     cap, "inner")
         mask = eval_predicate(node.filter, cand)
         out = compact.filter_batch(cand, mask)
-        if jt in ("left", "full"):
-            matched = jnp.zeros((left.capacity,), bool)
-            pp = jnp.asarray(out.column(ppos).data)
-            live_out = out.row_valid()
-            matched = matched.at[jnp.where(live_out, pp, 0)].max(
-                live_out)
-            unmatched = left.row_valid() & ~matched
-            pad = self._null_extend(left, right, unmatched)
-            out = Batch({s: c for s, c in out.columns.items()
-                         if s != ppos}, out.num_rows)
-            out = device_concat([out, pad])
-        else:
-            out = Batch({s: c for s, c in out.columns.items()
-                         if s != ppos}, out.num_rows)
-        if jt == "full":
-            out = self._append_right_unmatched(out, left, right,
-                                               pkeys, bkeys)
-        return out
+        return self._repair_outer(out, left, right, jt)
 
     def _cross_join(self, left: Batch, right: Batch, filt,
-                    outer: bool = False) -> Batch:
+                    jt: str = "inner") -> Batch:
+        """Cross / non-equi join (no equi criteria). For left/full outer
+        variants, probe/build positions are tracked through the filter so
+        unmatched rows null-extend (JoinNode with empty criteria in
+        sql/planner/plan/JoinNode.java; NestedLoopJoinOperator.java)."""
         nl, nr = left.num_rows_host(), right.num_rows_host()
         total = nl * nr
         cap = capacity_for(max(total, 1))
-        start, count, order = join_ops.cross_counts(left, right)
-        out = join_ops.expand_join(left, right, start, count, order, cap,
-                                   "inner")
+        probe = self._with_pos(left, _PPOS) if jt in ("left", "full") \
+            else left
+        build = self._with_pos(right, _BPOS) if jt == "full" else right
+        start, count, order = join_ops.cross_counts(probe, build)
+        out = join_ops.expand_join(probe, build, start, count, order,
+                                   cap, "inner")
         if filt is not None:
             mask = eval_predicate(filt, out)
             out = compact.filter_batch(out, mask)
+        return self._repair_outer(out, left, right, jt)
+
+    def _with_pos(self, b: Batch, name: str) -> Batch:
+        cols = dict(b.columns)
+        cols[name] = Column(
+            BIGINT, jnp.arange(b.capacity, dtype=jnp.int64), None)
+        return Batch(cols, b.num_rows)
+
+    def _repair_outer(self, out: Batch, left: Batch, right: Batch,
+                      jt: str) -> Batch:
+        """Strip position lanes; null-extend outer rows whose matches
+        all died in the filter (surviving-match repair)."""
+        live_out = out.row_valid()
+        pp = (jnp.asarray(out.column(_PPOS).data)
+              if jt in ("left", "full") else None)
+        bb = (jnp.asarray(out.column(_BPOS).data)
+              if jt == "full" else None)
+        if pp is not None or bb is not None:
+            out = Batch({s: c for s, c in out.columns.items()
+                         if s not in (_PPOS, _BPOS)}, out.num_rows)
+        if pp is not None:
+            matched = jnp.zeros((left.capacity,), bool).at[
+                jnp.where(live_out, pp, 0)].max(live_out)
+            unmatched = left.row_valid() & ~matched
+            out = device_concat(
+                [out, self._null_extend(left, right, unmatched)])
+        if bb is not None:
+            matched_b = jnp.zeros((right.capacity,), bool).at[
+                jnp.where(live_out, bb, 0)].max(live_out)
+            unmatched_b = right.row_valid() & ~matched_b
+            out = device_concat(
+                [out, self._null_extend_right(left, right, unmatched_b)])
         return out
 
     def _null_extend(self, left: Batch, right: Batch,
@@ -494,20 +515,28 @@ class Executor:
                              jnp.zeros((sub.capacity,), jnp.int64))
         return Batch(cols, sub.num_rows)
 
-    def _append_right_unmatched(self, out: Batch, left: Batch,
-                                right: Batch, pkeys, bkeys) -> Batch:
-        # FULL JOIN tail: right rows with no probe match, null-extended
-        start, count, order = join_ops.match_counts(
-            right, left, bkeys, pkeys)
-        unmatched = right.row_valid() & (count == 0)
-        sub = compact.filter_batch(right, unmatched)
+    def _null_extend_right(self, left: Batch, right: Batch,
+                           row_mask) -> Batch:
+        """Rows of ``right`` where mask, with all-NULL left columns."""
+        sub = compact.filter_batch(right, row_mask)
         cols = {}
         for s, c in left.columns.items():
             z = jnp.zeros((sub.capacity,), dtype=np.asarray(c.data).dtype)
             cols[s] = Column(c.type, z, jnp.zeros((sub.capacity,), bool),
-                             c.dictionary)
+                             c.dictionary,
+                             None if c.data2 is None else
+                             jnp.zeros((sub.capacity,), jnp.int64))
         cols.update(sub.columns)
-        pad = Batch(cols, sub.num_rows)
+        return Batch(cols, sub.num_rows)
+
+    def _append_right_unmatched(self, out: Batch, left: Batch,
+                                right: Batch, pkeys, bkeys) -> Batch:
+        # FULL JOIN tail (no residual filter): right rows with no key
+        # match, null-extended
+        start, count, order = join_ops.match_counts(
+            right, left, bkeys, pkeys)
+        unmatched = right.row_valid() & (count == 0)
+        pad = self._null_extend_right(left, right, unmatched)
         return device_concat([out, pad])
 
     def _exec_SemiJoinNode(self, node: SemiJoinNode) -> Batch:
@@ -601,8 +630,12 @@ class Executor:
         nr = jnp.asarray(g.column("__nr$").data)
         if node.op == "intersect":
             keep = (nl > 0) & (nr > 0)
-        else:
+        elif node.distinct:
             keep = (nl > 0) & (nr == 0)
+        else:
+            # EXCEPT ALL keeps rows with nl > nr, replicated nl-nr times
+            # (iterative/rule/ImplementExceptAll.java semantics)
+            keep = nl > nr
         out = compact.filter_batch(g, keep)
         if not node.distinct:
             # ALL semantics: replicate each row min/max-difference times

@@ -70,21 +70,22 @@ def execute_window(src: Batch, node: WindowNode) -> Batch:
     for sym, fn in node.functions.items():
         vals_s = _eval_fn(fn, src, order, live_s, pid_c, pos, part_start,
                           part_size, peer_boundary, row_in_part, node)
-        data, valid = vals_s
+        data, valid = vals_s[0], vals_s[1]
+        # lag/lead may return a merged dictionary as a third element
+        fn_dict = vals_s[2] if len(vals_s) > 2 else None
         # scatter back to input row order
         inv = jnp.zeros((cap,), jnp.int64).at[order].set(pos)
         out_data = jnp.take(data, inv)
         out_valid = None if valid is None else jnp.take(valid, inv)
-        col = Column(fn.type, out_data, out_valid)
-        if fn.argument is not None and fn.kind in ("min", "max",
-                                                   "any_value",
-                                                   "first_value",
-                                                   "last_value", "lag",
-                                                   "lead", "nth_value"):
-            srccol = src.column(fn.argument)
-            if srccol.dictionary is not None:
-                col = Column(fn.type, out_data.astype(jnp.int32),
-                             out_valid, srccol.dictionary)
+        if fn_dict is None and fn.argument is not None and \
+                fn.kind in ("min", "max", "any_value", "first_value",
+                            "last_value", "nth_value"):
+            fn_dict = src.column(fn.argument).dictionary
+        if fn_dict is not None:
+            col = Column(fn.type, out_data.astype(jnp.int32),
+                         out_valid, fn_dict)
+        else:
+            col = Column(fn.type, out_data, out_valid)
         out_cols[sym] = col
     return Batch(out_cols, src.num_rows)
 
@@ -122,9 +123,24 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
         rel = (ends - jnp.take(part_start, pid) + 1).astype(jnp.float64)
         return rel / jnp.maximum(n, 1.0), None
     if k == "ntile":
+        # ntile(b): first (n % b) buckets get ceil(n/b) rows, filled
+        # consecutively (operator/window/NTileFunction.java) — also
+        # correct when b > n, where each row gets its own bucket
         n = jnp.take(part_size, pid)
-        buckets = jnp.int64(4)  # argument support TBD
-        return (row_in_part * buckets) // jnp.maximum(n, 1) + 1, None
+        if fn.offset is None:
+            raise ValueError("ntile() requires a bucket-count argument")
+        bcol = src.column(fn.offset)
+        b = jnp.maximum(
+            jnp.take(jnp.asarray(bcol.data).astype(jnp.int64), order), 1)
+        b_valid = (None if bcol.valid is None
+                   else jnp.take(jnp.asarray(bcol.valid), order))
+        q, rem = n // b, n % b
+        thresh = rem * (q + 1)
+        r = row_in_part
+        bucket = jnp.where(
+            r < thresh, r // jnp.maximum(q + 1, 1),
+            rem + (r - thresh) // jnp.maximum(q, 1))
+        return bucket + 1, b_valid
 
     # value / aggregate functions need the argument lane in sorted order
     col = src.column(fn.argument) if fn.argument else None
@@ -151,13 +167,45 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
         last_pos = jnp.clip(last_pos, 0, cap - 1)
         return jnp.take(vals, last_pos), jnp.take(valid_lane, last_pos)
     if k in ("lag", "lead"):
-        off = 1
+        off_valid = None
+        if fn.offset is not None:
+            ocol = src.column(fn.offset)
+            off = jnp.take(
+                jnp.asarray(ocol.data).astype(jnp.int64), order)
+            if ocol.valid is not None:
+                # NULL offset -> NULL result (LagFunction.java semantics)
+                off_valid = jnp.take(jnp.asarray(ocol.valid), order)
+        else:
+            off = jnp.int64(1)
         tgt = pos - off if k == "lag" else pos + off
         same_part = (tgt >= jnp.take(part_start, pid)) & \
             (tgt < jnp.take(part_start, pid) + jnp.take(part_size, pid))
         tgt_c = jnp.clip(tgt, 0, cap - 1)
-        return (jnp.take(vals, tgt_c),
-                jnp.take(valid_lane, tgt_c) & same_part)
+        data = jnp.take(vals, tgt_c)
+        valid = jnp.take(valid_lane, tgt_c) & same_part
+        out_dict = col.dictionary if col is not None else None
+        if fn.default is not None:
+            dcol = src.column(fn.default)
+            dvals = jnp.asarray(dcol.data)
+            if out_dict is not None:
+                # codes from two pools: remap the default lane into a
+                # merged dictionary (DictionaryBlock id remapping)
+                if dcol.dictionary is None:
+                    raise ValueError(
+                        "lag/lead default for a dictionary column must "
+                        "be a string")
+                merged, _, remap_other = out_dict.merge(dcol.dictionary)
+                dvals = jnp.take(jnp.asarray(remap_other),
+                                 dvals.astype(jnp.int32))
+                out_dict = merged
+            dvals = jnp.take(dvals.astype(vals.dtype), order)
+            dvalid = (live_s if dcol.valid is None else
+                      live_s & jnp.take(jnp.asarray(dcol.valid), order))
+            data = jnp.where(same_part, data, dvals)
+            valid = jnp.where(same_part, valid, dvalid)
+        if off_valid is not None:
+            valid = valid & off_valid
+        return data, valid, out_dict
 
     # aggregates over the partition (or running when ordered)
     masked = jnp.where(valid_lane, vals, 0)

@@ -562,11 +562,13 @@ class LogicalPlanner:
             spec = call.window
             pre: Dict[str, RowExpr] = {}
 
-            def to_sym(aexpr) -> str:
-                e = ctx.rewrite(aexpr)
+            def to_sym(aexpr, label="winexpr") -> str:
+                return as_sym(ctx.rewrite(aexpr), label)
+
+            def as_sym(e, label="winexpr") -> str:
                 if isinstance(e, InputRef):
                     return e.name
-                s = self.symbols.new("winexpr")
+                s = self.symbols.new(label)
                 pre[s] = e
                 return s
 
@@ -577,14 +579,23 @@ class LogicalPlanner:
             args = [a for a in call.args if not isinstance(a, A.Star)]
             arg_sym = None
             atype: Optional[Type] = None
-            if args:
+            off_sym = None
+            def_sym = None
+            if call.name == "ntile":
+                # ntile(n): the single argument is the bucket count,
+                # not a value lane (operator/window/NTileFunction.java)
+                if args:
+                    off_sym = to_sym(args[0], "ntile_n")
+            elif args:
                 e0 = ctx.rewrite(args[0])
                 atype = e0.type
-                if isinstance(e0, InputRef):
-                    arg_sym = e0.name
-                else:
-                    arg_sym = self.symbols.new("winarg")
-                    pre[arg_sym] = e0
+                arg_sym = as_sym(e0, "winarg")
+                if call.name in ("lag", "lead"):
+                    # lag(x [, offset [, default]])
+                    if len(args) > 1:
+                        off_sym = to_sym(args[1], "winoff")
+                    if len(args) > 2:
+                        def_sym = to_sym(args[2], "windef")
             if is_window(call.name):
                 rtype = {"row_number": BIGINT, "rank": BIGINT,
                          "dense_rank": BIGINT, "ntile": BIGINT,
@@ -609,7 +620,8 @@ class LogicalPlanner:
                 frame_unit=frame.unit if frame else "range",
                 frame_start=frame.start_type if frame
                 else "unbounded_preceding",
-                frame_end=frame.end_type if frame else "current")
+                frame_end=frame.end_type if frame else "current",
+                offset=off_sym, default=def_sym)
             root = WindowNode(root, part, order, {out_sym: fn})
             win_map[call] = (out_sym, rtype)
         out = _ExprContext(self, ctx.scope, root, agg_map=ctx.agg_map,
@@ -756,14 +768,16 @@ class LogicalPlanner:
         criteria, residual = _extract_equi_criteria(on_expr, lsyms, rsyms)
 
         # non-equi comparisons referencing both sides stay as join filter;
-        # side-local conjuncts are pushed below (reference:
-        # optimizations/PredicatePushDown, done here at plan time)
+        # side-local conjuncts sink only to the INNER side of the join —
+        # an ON conjunct over the outer side's columns disqualifies
+        # matches but must never drop outer rows (reference:
+        # optimizations/PredicatePushDown.java outer-join handling)
         push_left, push_right, keep = [], [], []
         for c in residual:
             refs = rex.input_names(c)
-            if refs <= lsyms and rel.join_type in ("inner", "left"):
+            if refs <= lsyms and rel.join_type in ("inner", "right"):
                 push_left.append(c)
-            elif refs <= rsyms and rel.join_type in ("inner", "right"):
+            elif refs <= rsyms and rel.join_type in ("inner", "left"):
                 push_right.append(c)
             else:
                 keep.append(c)
@@ -1236,6 +1250,16 @@ def _plan_function(self: LogicalPlanner, e: A.FunctionCall,
 
 def _plan_literal(e: A.Literal) -> Const:
     v = e.value
+    if e.type_name == "decimal" and not isinstance(v, (int, float)):
+        # bare decimal literal: infer (precision, scale) from the text
+        # (reference: Literal analysis in ExpressionAnalyzer — "1.5" is
+        # DECIMAL(2,1), never the parse_type default decimal(38,0))
+        from decimal import Decimal as _D
+        d = _D(str(v))
+        tup = d.as_tuple()
+        scale = max(0, -tup.exponent)
+        precision = max(len(tup.digits), scale, 1)
+        return Const(str(v), DecimalType(precision, scale))
     if e.type_name is not None:
         t = parse_type(e.type_name)
         if t is DATE:

@@ -339,8 +339,9 @@ def _prune(node: PlanNode, needed: Set[str]) -> PlanNode:
         child |= set(node.partition_by)
         child |= {k.symbol for k in node.order_by}
         for f in node.functions.values():
-            if f.argument:
-                child.add(f.argument)
+            for sym in (f.argument, f.offset, f.default):
+                if sym:
+                    child.add(sym)
         return dc_replace(node, source=_prune(node.source, child))
 
     if isinstance(node, UnionNode):
