@@ -64,6 +64,33 @@ _TRACEABLE = ()  # filled after class definition
 _PPOS, _BPOS = "__probe_pos$", "__build_pos$"
 
 
+def _keys_inexact(cols, keys) -> bool:
+    """True when the uint64 equality lane of ops/join.py cannot be
+    bijective for these keys: multi-column (hash-combined), float
+    (hash-converted), or Int128 decimal (only the low lane is hashed)."""
+    if len(keys) > 1:
+        return True
+    c = cols[keys[0]]
+    return c.data2 is not None or np.asarray(c.data).dtype.kind == "f"
+
+
+def join_verify_filter(left_cols, right_cols, pkeys, bkeys, filt):
+    """Hash-collision re-verification (reference: JoinProbe verifies
+    candidate positions by real key equality, never by hash alone).
+    When the key lane is inexact, append key-equality conjuncts to the
+    residual filter; the residual join path then drops collision rows
+    and repairs outer rows from the surviving match set."""
+    if not (_keys_inexact(left_cols, pkeys)
+            or _keys_inexact(right_cols, bkeys)):
+        return filt
+    from ..rex import Call as _RCall, and_all
+    eqs = [
+        _RCall("=", (InputRef(pk, left_cols[pk].type),
+                     InputRef(bk, right_cols[bk].type)), BOOLEAN)
+        for pk, bk in zip(pkeys, bkeys)]
+    return and_all(([filt] if filt is not None else []) + eqs)
+
+
 class Executor:
     def __init__(self, catalogs: CatalogManager, session: Session,
                  collect_stats: bool = False,
@@ -416,7 +443,9 @@ class Executor:
 
         pkeys = [c.left for c in node.criteria]
         bkeys = [c.right for c in node.criteria]
-        if node.filter is None:
+        filt = join_verify_filter(left.columns, right.columns,
+                                  pkeys, bkeys, node.filter)
+        if filt is None:
             start, count, order = join_ops.match_counts(
                 left, right, pkeys, bkeys)
             outer = jt in ("left", "full")
@@ -445,7 +474,7 @@ class Executor:
         cap = capacity_for(total)
         cand = join_ops.expand_join(probe, build, start, count, order,
                                     cap, "inner")
-        mask = eval_predicate(node.filter, cand)
+        mask = eval_predicate(filt, cand)
         out = compact.filter_batch(cand, mask)
         return self._repair_outer(out, left, right, jt)
 
@@ -557,12 +586,16 @@ class Executor:
         filt = self.execute(node.filtering_source)
         skeys = list(node.source_keys)
         fkeys = list(node.filtering_keys)
-        if node.filter is None and skeys:
+        residual = (join_verify_filter(src.columns, filt.columns,
+                                       skeys, fkeys, node.filter)
+                    if skeys else node.filter)
+        if residual is None and skeys:
             matched, _, _, _ = join_ops.semi_join_mask(
                 src, filt, skeys, fkeys)
             cols = dict(src.columns)
             cols[node.output] = Column(BOOLEAN, matched, None)
             return Batch(cols, src.num_rows)
+        node = dc_replace(node, filter=residual)
         # residual filter path: expand candidate matches, filter, then
         # mark probe rows with surviving matches
         ppos = "__probe_pos$"

@@ -33,9 +33,11 @@ _U64MAX = jnp.uint64(0xFFFFFFFFFFFFFFFF)
 class AggInput:
     """One aggregate over one input lane (or none, for count(*))."""
     kind: str          # sum | count | count_star | min | max | any_value
+                       # | argmin | argmax | count_distinct | percentile
     input: Optional[str] = None   # column name; None for count_star
     mask: Optional[str] = None    # FILTER / mask column (boolean), optional
     output: str = "agg"
+    param: Optional[float] = None  # percentile fraction for 'percentile'
 
 
 def _key_lanes(batch: Batch, key_names: Sequence[str]) -> List[jax.Array]:
@@ -114,7 +116,7 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
 
     for agg in aggs:
         out_cols[agg.output] = _segment_agg(
-            batch, agg, order, gid_c, live_s, gcap)
+            batch, agg, order, gid_c, live_s, gcap, lanes)
 
     return Batch(out_cols, num_groups)
 

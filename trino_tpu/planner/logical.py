@@ -457,6 +457,8 @@ class LogicalPlanner:
                 _require_boolean(m, "FILTER")
                 mask_sym = self.symbols.new("mask")
                 pre_assigns[mask_sym] = m
+            arg2_sym = None
+            param = None
             if call.name == "count" and (star or not args):
                 kind, arg_sym, rtype = "count_star", None, BIGINT
             else:
@@ -470,12 +472,36 @@ class LogicalPlanner:
                     arg_sym = self.symbols.new(f"{kind}_arg")
                     pre_assigns[arg_sym] = a0
                 if len(args) > 1:
-                    raise PlanningError(
-                        f"{kind}: multi-argument aggregates not yet "
-                        "supported")
+                    if kind == "approx_percentile":
+                        # percentage must be constant (the reference's
+                        # ApproximateDoublePercentileAggregations also
+                        # requires a per-query-constant percentile)
+                        a1 = args[1]
+                        if not isinstance(a1, Const) or a1.value is None:
+                            raise PlanningError(
+                                "approx_percentile: percentage must be "
+                                "a constant")
+                        param = float(a1.value)
+                    elif kind in ("min_by", "max_by", "corr",
+                                  "covar_samp", "covar_pop",
+                                  "regr_slope", "regr_intercept"):
+                        a1 = args[1]
+                        if isinstance(a1, InputRef):
+                            arg2_sym = a1.name
+                        else:
+                            arg2_sym = self.symbols.new(f"{kind}_arg2")
+                            pre_assigns[arg2_sym] = a1
+                    else:
+                        raise PlanningError(
+                            f"{kind}: multi-argument aggregates not yet "
+                            "supported")
+                    if len(args) > 2:
+                        raise PlanningError(
+                            f"{kind}: too many arguments")
             out_sym = self.symbols.new(call.name)
             aggregates[out_sym] = Aggregate(kind, arg_sym, rtype,
-                                            call.distinct, mask_sym)
+                                            call.distinct, mask_sym,
+                                            arg2_sym, param)
             agg_map[call] = (out_sym, rtype)
 
         root = ctx.root

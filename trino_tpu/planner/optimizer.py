@@ -32,11 +32,14 @@ from ..planner.logical import SemiJoinMultiNode
 from ..rex import Call, Const, InputRef, RowExpr, TRUE
 
 
-def optimize(plan: PlanNode, catalogs=None) -> PlanNode:
+def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
     plan = push_filters(plan)
     if catalogs is not None:
         from .stats import choose_join_sides
-        plan = choose_join_sides(plan, catalogs)
+        force = "AUTOMATIC"
+        if session is not None:
+            force = session.get("join_distribution_type") or "AUTOMATIC"
+        plan = choose_join_sides(plan, catalogs, force)
     plan = prune_columns(plan)
     plan = cleanup_projects(plan)
     return plan
@@ -282,10 +285,9 @@ def _prune(node: PlanNode, needed: Set[str]) -> PlanNode:
             # aggregates all pruned -> keep none; grouping keys remain
             aggs = {}
         for a in aggs.values():
-            if a.argument:
-                child_needed.add(a.argument)
-            if a.mask:
-                child_needed.add(a.mask)
+            for sym in (a.argument, a.argument2, a.mask):
+                if sym:
+                    child_needed.add(sym)
         return dc_replace(node, source=_prune(node.source, child_needed),
                           aggregates=aggs)
 

@@ -97,13 +97,17 @@ def _selectivity(e) -> float:
 
 
 def choose_join_sides(node: PlanNode,
-                      catalogs: CatalogManager) -> PlanNode:
+                      catalogs: CatalogManager,
+                      force_dist: str = "AUTOMATIC") -> PlanNode:
     """Make the smaller input the hash-build (right) side and pick the
     exchange distribution. Inner equi-joins only — outer joins keep
-    their probe side (the executor flips RIGHT joins itself)."""
+    their probe side (the executor flips RIGHT joins itself).
+    ``force_dist`` is the join_distribution_type session property
+    (SystemSessionProperties.java:53): AUTOMATIC | BROADCAST |
+    PARTITIONED."""
     if isinstance(node, JoinNode):
-        left = choose_join_sides(node.left, catalogs)
-        right = choose_join_sides(node.right, catalogs)
+        left = choose_join_sides(node.left, catalogs, force_dist)
+        right = choose_join_sides(node.right, catalogs, force_dist)
         node = dc_replace(node, left=left, right=right)
         if node.join_type == "inner" and node.criteria:
             l_est = estimate_rows(node.left, catalogs)
@@ -115,8 +119,14 @@ def choose_join_sides(node: PlanNode,
                           for c in node.criteria),
                     node.filter, node.distribution)
                 l_est, r_est = r_est, l_est
-            dist = ("replicated" if r_est <= BROADCAST_ROWS
-                    else "partitioned")
+            f = (force_dist or "AUTOMATIC").upper()
+            if f == "PARTITIONED":
+                dist = "partitioned"
+            elif f == "BROADCAST":
+                dist = "replicated"
+            else:
+                dist = ("replicated" if r_est <= BROADCAST_ROWS
+                        else "partitioned")
             node = dc_replace(node, distribution=dist)
         return node
     if not node.sources:
@@ -127,11 +137,13 @@ def choose_join_sides(node: PlanNode,
         for f in dataclasses.fields(node):
             v = getattr(node, f.name)
             if isinstance(v, PlanNode):
-                updates[f.name] = choose_join_sides(v, catalogs)
+                updates[f.name] = choose_join_sides(v, catalogs,
+                                                    force_dist)
             elif isinstance(v, tuple) and v and all(
                     isinstance(x, PlanNode) for x in v):
                 updates[f.name] = tuple(
-                    choose_join_sides(x, catalogs) for x in v)
+                    choose_join_sides(x, catalogs, force_dist)
+                    for x in v)
         if updates:
             return dc_replace(node, **updates)
     return node

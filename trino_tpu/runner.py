@@ -107,7 +107,8 @@ class LocalQueryRunner:
             raise QueryError("only queries can be planned")
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = planner.plan(stmt)
-        return optimize(plan, self.catalogs) if optimized else plan
+        return optimize(plan, self.catalogs, self.session) \
+            if optimized else plan
 
     # ------------------------------------------------------------------
     def _dispatch(self, stmt: A.Statement) -> QueryResult:
@@ -196,7 +197,7 @@ class LocalQueryRunner:
                    collect_stats: bool = False):
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = planner.plan(stmt)
-        plan = optimize(plan, self.catalogs)
+        plan = optimize(plan, self.catalogs, self.session)
         ex = self._make_executor(collect_stats)
         batch = ex.execute(plan)
         schema = batch.schema()
@@ -212,7 +213,8 @@ class LocalQueryRunner:
         if not isinstance(inner, A.QueryStatement):
             raise QueryError("EXPLAIN supports queries only")
         planner = LogicalPlanner(self.catalogs, self.session)
-        plan = optimize(planner.plan(inner), self.catalogs)
+        plan = optimize(planner.plan(inner), self.catalogs,
+                        self.session)
         if stmt.analyze:
             res = self._run_query(inner, collect_stats=True)
             lines = plan_tree_lines(plan)
