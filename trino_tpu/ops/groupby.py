@@ -122,7 +122,7 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
 
 
 def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
-                 gcap: int) -> Column:
+                 gcap: int, key_lanes=None) -> Column:
     from ..types import BIGINT, DOUBLE, is_string
 
     extra_mask = None
