@@ -66,22 +66,51 @@ def _bench_once() -> float:
     dev = [jax.device_put(jnp.asarray(c)) for c in padded]
     n = jnp.asarray(rows, jnp.int64)
 
+    def fetch(out, ng):
+        # the timed unit ends with results ON HOST: under the axon
+        # tunnel block_until_ready returns before execution completes
+        # (measured: 0.27ms "latency" for a 9s computation), so a real
+        # host readback is the only honest fence
+        return {k: np.asarray(v) for k, v in out.items()}, int(ng)
+
     step = jax.jit(_q1_step)
-    out, ng = step(*dev, n)  # compile + warm
-    jax.block_until_ready(out)
+    fetch(*step(*dev, n))  # compile + warm
     best = float("inf")
     for _ in range(N_ITERS):
         t0 = time.perf_counter()
-        out, ng = step(*dev, n)
-        jax.block_until_ready(out)
+        fetch(*step(*dev, n))
         best = min(best, time.perf_counter() - t0)
     return rows / best
 
 
-def _probe_subprocess(extra_env, iters=None):
+def _bench_engine_once() -> float:
+    """rows/sec of SQL TPC-H q1 @ sf1 through the FULL engine path
+    (parse -> plan -> optimize -> execute) — the honest engine-level
+    number BASELINE.json asks for, alongside the hand-fused micro
+    (the reference's HandTpchQuery1.java vs the operator path)."""
+    import trino_tpu  # noqa: F401
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.session import Session
+
+    sf = {1.0: "sf1", 0.01: "tiny"}.get(ROWS_SCALE, "sf1")
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema=sf))
+    rows = 6_001_215 if sf == "sf1" else 60_175
+    r.execute(TPCH_QUERIES[1])      # compile + warm every fragment
+    best = float("inf")
+    for _ in range(max(N_ITERS // 2, 1)):
+        t0 = time.perf_counter()
+        res = r.execute(TPCH_QUERIES[1])
+        assert len(res.rows) >= 4
+        best = min(best, time.perf_counter() - t0)
+    return rows / best
+
+
+def _probe_subprocess(extra_env, iters=None, mode="micro"):
     """Run --probe in a fresh interpreter; returns (rows_per_sec, err)."""
     env = dict(os.environ)
     env.update(extra_env)
+    env["BENCH_MODE"] = mode
     if iters is not None:
         env["BENCH_ITERS"] = str(iters)
     try:
@@ -116,48 +145,61 @@ def main():
             import jax
             jax.config.update("jax_platforms", want)
         try:
-            rps = _bench_once()
+            if os.environ.get("BENCH_MODE") == "engine":
+                rps = _bench_engine_once()
+            else:
+                rps = _bench_once()
             print(json.dumps({"rows_per_sec": rps}))
         except Exception as e:
             print(json.dumps({"error": f"{type(e).__name__}: {e}"[:400]}))
             raise
         return
 
-    # --- device leg: fresh subprocess per attempt, with retry ---------
-    tpu_rps, tpu_err = None, None
+    cpu_env = {"PYTHONPATH": "",   # skip the TPU-forcing sitecustomize
+               "JAX_PLATFORMS": "cpu",
+               "BENCH_PLATFORM": "cpu"}
+
+    # --- device legs: fresh subprocess per attempt, with retry --------
+    tpu_eng, eng_err = None, None
     for attempt in range(TPU_ATTEMPTS):
-        tpu_rps, tpu_err = _probe_subprocess({})
-        if tpu_rps:
+        tpu_eng, eng_err = _probe_subprocess({}, mode="engine")
+        if tpu_eng:
             break
         if attempt < TPU_ATTEMPTS - 1:
             time.sleep(min(30, 5 * (attempt + 1)))
+    tpu_micro, micro_err = _probe_subprocess({}, mode="micro")
 
-    if not tpu_rps:
-        print(json.dumps({"metric": "tpch_q1_sf1_rows_per_sec_per_chip",
+    if not tpu_eng and not tpu_micro:
+        print(json.dumps({"metric": "tpch_q1_sf1_engine_rows_per_sec",
                           "value": 0.0, "unit": "rows/s",
                           "vs_baseline": 0.0,
-                          "error": (tpu_err or "unknown")[:400],
+                          "error": (eng_err or micro_err
+                                    or "unknown")[:400],
                           "attempts": TPU_ATTEMPTS}))
         return
 
-    # --- CPU-worker baseline leg (north-star denominator) -------------
-    cpu_rps, cpu_err = _probe_subprocess(
-        {"PYTHONPATH": "",           # skip the TPU-forcing sitecustomize
-         "JAX_PLATFORMS": "cpu",
-         "BENCH_PLATFORM": "cpu"}, iters=2)
+    # --- CPU-worker baseline legs (north-star denominator) ------------
+    cpu_eng, cpu_eng_err = _probe_subprocess(cpu_env, iters=2,
+                                             mode="engine")
+    cpu_micro, _ = _probe_subprocess(cpu_env, iters=2, mode="micro")
 
-    vs = (tpu_rps / cpu_rps) if cpu_rps else 0.0
+    value = tpu_eng or 0.0
+    vs = (value / cpu_eng) if (value and cpu_eng) else 0.0
     report = {
-        "metric": "tpch_q1_sf1_rows_per_sec_per_chip",
-        "value": round(tpu_rps, 1),
+        "metric": "tpch_q1_sf1_engine_rows_per_sec",
+        "value": round(value, 1),
         "unit": "rows/s",
         "vs_baseline": round(vs, 2),
-        "baseline": "same engine, 1 host CPU worker "
-                    f"({round(cpu_rps, 1) if cpu_rps else 'n/a'} rows/s); "
-                    "north star is >=5x (BASELINE.json)",
+        "baseline": "SQL q1 sf1 through the same engine on 1 host CPU "
+                    f"worker ({round(cpu_eng, 1) if cpu_eng else 'n/a'} "
+                    "rows/s); north star >=5x (BASELINE.json)",
+        "micro_rows_per_sec": round(tpu_micro or 0.0, 1),
+        "micro_vs_cpu": (round(tpu_micro / cpu_micro, 2)
+                         if tpu_micro and cpu_micro else 0.0),
     }
-    if not cpu_rps:
-        report["error"] = f"cpu baseline probe failed: {cpu_err}"[:400]
+    errs = [e for e in (eng_err, cpu_eng_err) if e]
+    if errs:
+        report["error"] = " | ".join(errs)[:400]
     print(json.dumps(report))
 
 

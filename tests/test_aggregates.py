@@ -1,0 +1,238 @@
+"""Aggregate-function breadth tests.
+
+Reference parity: operator/aggregation/ (98 builtins) —
+MinMaxByAggregationFunction, ApproximateCountDistinctAggregation,
+CovarianceAggregation, CentralMomentsAggregation, ChecksumAggregation,
+ApproximateDoublePercentileAggregations. Oracles are sqlite (stdlib) for
+count-distinct shapes and numpy closed forms for the statistical family.
+"""
+
+import math
+import sqlite3
+
+import numpy as np
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+@pytest.fixture(scope="module")
+def li(runner):
+    """(partkey, quantity, extendedprice) of tiny lineitem + a sqlite
+    mirror for oracle queries."""
+    rows = q(runner, "SELECT l_partkey, l_quantity, l_extendedprice "
+                     "FROM tpch.tiny.lineitem")
+    con = sqlite3.connect(":memory:")
+    con.execute("CREATE TABLE t(pk INT, qty REAL, price REAL)")
+    con.executemany("INSERT INTO t VALUES (?,?,?)", rows)
+    return np.asarray(rows, dtype=float), con
+
+
+# -- count(DISTINCT) / approx_distinct --------------------------------------
+
+def test_count_distinct_global(runner, li):
+    _, con = li
+    exp = con.execute("SELECT count(DISTINCT qty) FROM t").fetchone()[0]
+    got = q(runner, "SELECT count(DISTINCT l_quantity), "
+                    "approx_distinct(l_quantity) FROM tpch.tiny.lineitem")
+    assert got[0] == [exp, exp]
+
+
+def test_count_distinct_grouped(runner, li):
+    _, con = li
+    exp = [list(r) for r in con.execute(
+        "SELECT pk % 11, count(DISTINCT qty), count(*) FROM t "
+        "GROUP BY pk % 11 ORDER BY 1")]
+    got = q(runner, "SELECT l_partkey % 11, count(DISTINCT l_quantity), "
+                    "count(*) FROM tpch.tiny.lineitem "
+                    "GROUP BY l_partkey % 11 ORDER BY 1")
+    assert got == exp
+
+
+def test_count_distinct_strings_and_nulls(runner):
+    got = q(runner, "SELECT count(DISTINCT x) FROM (VALUES 'a', 'b', "
+                    "'a', NULL, 'c', NULL) t(x)")
+    assert got == [[3]]
+
+
+def test_count_distinct_with_filter(runner):
+    got = q(runner, "SELECT count(DISTINCT x) FILTER (WHERE x > 1) "
+                    "FROM (VALUES 1, 2, 2, 3, NULL) t(x)")
+    assert got == [[2]]
+
+
+# -- min_by / max_by --------------------------------------------------------
+
+def test_min_max_by_global(runner, li):
+    arr, _ = li
+    pk, qty, price = arr[:, 0], arr[:, 1], arr[:, 2]
+    exp_min = pk[np.argmin(price)]
+    exp_max = pk[np.argmax(price)]
+    got = q(runner, "SELECT min_by(l_partkey, l_extendedprice), "
+                    "max_by(l_partkey, l_extendedprice) "
+                    "FROM tpch.tiny.lineitem")
+    assert got == [[int(exp_min), int(exp_max)]]
+
+
+def test_min_by_grouped_strings(runner):
+    got = q(runner, "SELECT n_regionkey, min_by(n_name, n_nationkey), "
+                    "max_by(n_name, n_nationkey) FROM tpch.tiny.nation "
+                    "GROUP BY n_regionkey ORDER BY n_regionkey")
+    # first/last nation name per region by nationkey
+    names = q(runner, "SELECT n_regionkey, n_nationkey, n_name "
+                      "FROM tpch.tiny.nation ORDER BY n_nationkey")
+    by_region = {}
+    for rk, nk, nm in names:
+        lo, hi = by_region.get(rk, (None, None))
+        if lo is None:
+            by_region[rk] = (nm, nm)
+        else:
+            by_region[rk] = (lo, nm)
+    exp = [[rk, *by_region[rk]] for rk in sorted(by_region)]
+    assert got == exp
+
+
+def test_min_by_null_comparators_ignored(runner):
+    got = q(runner, "SELECT min_by(a, b) FROM (VALUES "
+                    "(1, NULL), (2, 10), (3, 5)) t(a, b)")
+    assert got == [[3]]
+    got = q(runner, "SELECT min_by(a, b) FROM (VALUES "
+                    "(CAST(NULL AS bigint), NULL)) t(a, b)")
+    assert got == [[None]]
+
+
+# -- approx_percentile ------------------------------------------------------
+
+def test_percentile_global(runner, li):
+    arr, _ = li
+    qty = np.sort(arr[:, 1])
+    for frac in (0.0, 0.25, 0.5, 0.9, 1.0):
+        got = q(runner, f"SELECT approx_percentile(l_quantity, {frac}) "
+                        "FROM tpch.tiny.lineitem")[0][0]
+        k = int(np.clip(math.floor(frac * (len(qty) - 1) + 0.5),
+                        0, len(qty) - 1))
+        assert got == qty[k], frac
+
+
+def test_percentile_grouped(runner):
+    got = q(runner, "SELECT x % 2, approx_percentile(x, 0.5) FROM "
+                    "(VALUES 1, 2, 3, 4, 5, 6, 7) t(x) "
+                    "GROUP BY x % 2 ORDER BY 1")
+    # odd: 1 3 5 7 -> median ~ 5 (nearest-rank of 0.5*(4-1)+0.5 = 2);
+    # even: 2 4 6 -> 4
+    assert got == [[0, 4], [1, 5]]
+
+
+# -- statistical family -----------------------------------------------------
+
+def test_corr_covar_regr(runner, li):
+    arr, _ = li
+    qty, price = arr[:, 1], arr[:, 2]
+    got = q(runner, "SELECT corr(l_extendedprice, l_quantity), "
+                    "covar_pop(l_extendedprice, l_quantity), "
+                    "covar_samp(l_extendedprice, l_quantity), "
+                    "regr_slope(l_extendedprice, l_quantity), "
+                    "regr_intercept(l_extendedprice, l_quantity) "
+                    "FROM tpch.tiny.lineitem")[0]
+    n = len(qty)
+    exp_corr = np.corrcoef(price, qty)[0, 1]
+    exp_cpop = np.cov(price, qty, bias=True)[0, 1]
+    exp_csamp = np.cov(price, qty, bias=False)[0, 1]
+    slope, intercept = np.polyfit(qty, price, 1)
+    for g, e in zip(got, (exp_corr, exp_cpop, exp_csamp, slope,
+                          intercept)):
+        assert g == pytest.approx(e, rel=1e-9)
+
+
+def test_corr_pairwise_nulls(runner):
+    # rows with a NULL on either side are excluded pairwise
+    got = q(runner, "SELECT covar_pop(y, x), count(*) FROM (VALUES "
+                    "(1.0, 2.0), (2.0, 4.0), (NULL, 9.0), (3.0, NULL)) "
+                    "t(y, x)")[0]
+    assert got[0] == pytest.approx(np.cov([1, 2], [2, 4],
+                                          bias=True)[0, 1])
+    assert got[1] == 4
+
+
+def test_skewness_kurtosis(runner, li):
+    arr, _ = li
+    x = arr[:, 2]
+    n = len(x)
+    m = x.mean()
+    m2 = ((x - m) ** 2).sum()
+    m3 = ((x - m) ** 3).sum()
+    m4 = ((x - m) ** 4).sum()
+    exp_skew = math.sqrt(n) * m3 / m2 ** 1.5
+    exp_kurt = (n * (n + 1.0) / ((n - 1.0) * (n - 2.0) * (n - 3.0))
+                * (n * m4 / (m2 * m2))
+                - 3.0 * (n - 1.0) ** 2 / ((n - 2.0) * (n - 3.0)))
+    got = q(runner, "SELECT skewness(l_extendedprice), "
+                    "kurtosis(l_extendedprice) FROM tpch.tiny.lineitem")
+    assert got[0][0] == pytest.approx(exp_skew, rel=1e-9)
+    assert got[0][1] == pytest.approx(exp_kurt, rel=1e-6)
+
+
+def test_skewness_small_n_null(runner):
+    got = q(runner, "SELECT skewness(x), kurtosis(x) FROM "
+                    "(VALUES 1.0, 2.0) t(x)")
+    assert got == [[None, None]]
+
+
+# -- checksum ---------------------------------------------------------------
+
+def test_checksum_order_independent(runner):
+    a = q(runner, "SELECT checksum(x) FROM (VALUES 1, 2, 3) t(x)")
+    b = q(runner, "SELECT checksum(x) FROM (VALUES 3, 1, 2) t(x)")
+    c = q(runner, "SELECT checksum(x) FROM (VALUES 3, 1, 4) t(x)")
+    assert a == b
+    assert a != c
+    # NULLs participate (multiset semantics)
+    d = q(runner, "SELECT checksum(x) FROM (VALUES 1, NULL, 2) t(x)")
+    e = q(runner, "SELECT checksum(x) FROM (VALUES 1, 2) t(x)")
+    assert d != e
+
+
+def test_checksum_grouped_strings(runner):
+    got = q(runner, "SELECT n_regionkey, checksum(n_name) "
+                    "FROM tpch.tiny.nation GROUP BY n_regionkey")
+    assert len(got) == 5
+    assert all(r[1] is not None for r in got)
+
+
+# -- distributed equivalence for non-decomposable kinds ---------------------
+
+@pytest.fixture(scope="module")
+def dist_runner():
+    return LocalQueryRunner(distributed=True, n_devices=8)
+
+
+def test_distributed_nondecomposable_grouped(runner, dist_runner):
+    sql = ("SELECT l_partkey % 5, count(DISTINCT l_quantity), "
+           "min_by(l_orderkey, l_extendedprice), "
+           "approx_percentile(l_quantity, 0.5) "
+           "FROM tpch.tiny.lineitem GROUP BY l_partkey % 5 ORDER BY 1")
+    assert q(dist_runner, sql) == q(runner, sql)
+
+
+def test_distributed_nondecomposable_global(runner, dist_runner):
+    sql = ("SELECT count(DISTINCT l_suppkey), "
+           "max_by(l_orderkey, l_extendedprice) "
+           "FROM tpch.tiny.lineitem")
+    assert q(dist_runner, sql) == q(runner, sql)
+
+
+def test_mixed_same_arg_distinct(runner):
+    # sum(DISTINCT x) + count(DISTINCT x) share the inner-group-by
+    # rewrite; count(DISTINCT)-only mixes run natively
+    got = q(runner, "SELECT sum(DISTINCT x), count(DISTINCT x), "
+                    "avg(DISTINCT x) FROM (VALUES 1, 2, 2, 3) t(x)")
+    assert got == [[6, 3, 2.0]]

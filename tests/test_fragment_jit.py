@@ -1,7 +1,12 @@
 """Fragment-JIT tests: pipeline chains compiled as one XLA program must
-be bit-identical to eager execution (reference analog: compiled
-PageProcessor vs interpreted path, sql/gen/PageFunctionCompiler.java:101
-vs ExpressionInterpreter)."""
+match eager execution (reference analog: compiled PageProcessor vs
+interpreted path, sql/gen/PageFunctionCompiler.java:101 vs
+ExpressionInterpreter). Floating-point aggregates compare with a 1e-9
+relative tolerance: XLA may reassociate reductions when fusing, so the
+compiled sum order legitimately differs from the eager one (SURVEY.md
+§7 hard part 6)."""
+
+import math
 
 import pytest
 
@@ -29,10 +34,22 @@ def _both(runner, sql):
     return eager, jitted
 
 
+def assert_rows_close(a, b):
+    assert len(a) == len(b)
+    for ra, rb in zip(a, b):
+        assert len(ra) == len(rb)
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) and isinstance(y, float):
+                assert math.isclose(x, y, rel_tol=1e-9, abs_tol=1e-9), \
+                    (x, y)
+            else:
+                assert x == y, (x, y)
+
+
 @pytest.mark.parametrize("q", [1, 6, 12])
 def test_tpch_jit_matches_eager(runner, q):
     eager, jitted = _both(runner, TPCH_QUERIES[q])
-    assert eager == jitted
+    assert_rows_close(eager, jitted)
 
 
 def test_jit_with_strings_and_nulls(runner):

@@ -38,9 +38,6 @@ class EngineConfig:
     # Minimum physical capacity bucket, to bound the number of distinct
     # compiled shapes (each bucket is a separate XLA compilation).
     min_capacity: int = 1 << 10
-    # Default number of hash partitions for distributed exchanges
-    # (Trino: query.initial-hash-partitions, QueryManagerConfig.java:132).
-    hash_partition_count: int = _env_int("TRINO_TPU_HASH_PARTITIONS", 8)
     # Per-query memory limit in bytes (Trino: query.max-memory-per-node).
     max_query_memory_per_node: int = _env_int(
         "TRINO_TPU_QUERY_MAX_MEMORY", 16 << 30
@@ -50,6 +47,28 @@ class EngineConfig:
 
 
 CONFIG = EngineConfig()
+
+
+class MemoryLimitExceeded(Exception):
+    """EXCEEDED_LOCAL_MEMORY_LIMIT (spi/StandardErrorCode.java analog):
+    a capacity decision would allocate more device memory than the
+    query_max_memory_per_node session property allows."""
+
+
+def reserve_bytes(rows: int, n_lanes: int, limit_bytes: int,
+                  what: str) -> int:
+    """Check an allocation of rows x n_lanes 8-byte device lanes against
+    the per-node query memory limit (memory/ ClusterMemoryManager +
+    LocalMemoryContext reservation, collapsed to the single decision
+    point that matters in this engine: capacity planning)."""
+    est = rows * max(n_lanes, 1) * 8
+    if est > limit_bytes:
+        raise MemoryLimitExceeded(
+            f"Query exceeded per-node memory limit of {limit_bytes} "
+            f"bytes ({what} needs ~{est} bytes for {rows} rows x "
+            f"{n_lanes} lanes); raise query_max_memory_per_node or "
+            "enable spill")
+    return est
 
 
 def capacity_for(n: int, minimum: int | None = None) -> int:

@@ -35,7 +35,8 @@ from ..catalog import CatalogManager
 from ..columnar import Batch, Column
 from ..config import capacity_for
 from ..ops import compact, join as join_ops, sort as sort_ops
-from ..ops.groupby import AggInput, global_aggregate, group_aggregate
+from ..ops.groupby import (COMBINABLE_KINDS as _COMBINABLE, AggInput,
+                           global_aggregate, group_aggregate)
 from ..parallel.mesh import (AXIS, ShardedBatch, get_mesh, shard_parts,
                              unshard_batch)
 from ..parallel.spmd import (broadcast_sharded,
@@ -80,6 +81,9 @@ class DistributedExecutor(Executor):
         return self._host(self.execute(node))
 
     def execute(self, node: PlanNode):  # type: ignore[override]
+        cancel = getattr(self.session, "cancel", None)
+        if cancel is not None and cancel.is_set():
+            raise QueryError("Query was canceled")
         method = getattr(self, "_dexec_" + type(node).__name__, None)
         if method is not None:
             return method(node)
@@ -221,6 +225,10 @@ class DistributedExecutor(Executor):
         # global aggregation: per-shard partials -> gather -> combine
         if not phys:
             return self._single_row(None)
+        if any(a.kind not in _COMBINABLE for a in phys):
+            # non-decomposable kinds: gather rows, aggregate exactly
+            return super()._exec_AggregationNode(
+                dc_replace(node, source=_Pre(self._host(src))))
         partial = shard_apply(
             src, lambda b: _pad_one(global_aggregate(b, phys)),
             out_cap=8)
@@ -444,8 +452,7 @@ class _Pre(PlanNode):
 
 
 def _combine_kind(kind: str) -> str:
-    return {"sum": "sum", "count": "sum", "count_star": "sum",
-            "min": "min", "max": "max", "any_value": "any_value"}[kind]
+    return _COMBINABLE[kind]
 
 
 def _pad_one(b: Batch) -> Batch:

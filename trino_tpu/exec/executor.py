@@ -24,7 +24,8 @@ import numpy as np
 from ..catalog import CatalogManager
 from ..columnar import (Batch, Column, StringDictionary, batch_from_pylist,
                         empty_batch, pad_batch)
-from ..config import capacity_for
+from ..config import (CONFIG, MemoryLimitExceeded, capacity_for,
+                      reserve_bytes)
 from ..ops import compact, join as join_ops, sort as sort_ops
 from ..ops.groupby import AggInput, global_aggregate, group_aggregate
 from ..ops.hashing import hash_columns, partition_of
@@ -109,6 +110,11 @@ class Executor:
 
     # ------------------------------------------------------------------
     def execute(self, node: PlanNode) -> Batch:
+        cancel = getattr(self.session, "cancel", None)
+        if cancel is not None and cancel.is_set():
+            # cooperative cancellation between plan nodes (reference:
+            # Driver loop checks the yield/termination signal)
+            raise QueryError("Query was canceled")
         t0 = time.perf_counter() if self.collect_stats else 0.0
         out = self._execute_inner(node)
         if self.collect_stats:
@@ -124,6 +130,9 @@ class Executor:
             streamed = self._try_streaming_aggregation(node)
             if streamed is not None:
                 return streamed
+            masked = self._try_masked_filter_aggregation(node)
+            if masked is not None:
+                return masked
         if self.fragment_jit and isinstance(node, _TRACEABLE):
             chain = []
             cur = node
@@ -161,7 +170,15 @@ class Executor:
     # ------------------------------------------------------------------
     _STREAM_CHAIN = None   # set after class body
 
+    _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
+                      "approx_percentile"}
+
     def _try_streaming_aggregation(self, node: AggregationNode):
+        # kinds whose partials don't combine with a single-lane segment
+        # op need all rows at once — no split-streaming for them
+        if any(a.distinct or a.kind in self._NONSTREAMABLE
+               for a in node.aggregates.values()):
+            return None
         chain = []
         cur = node.source
         while isinstance(cur, self._STREAM_CHAIN):
@@ -180,16 +197,19 @@ class Executor:
         phys = post = None
 
         def run(b: Batch) -> Batch:
-            for nd in reversed(chain):
-                b = self._dispatch_apply(nd, b)
-            _p, _post, extra = _lower_aggregates(node.aggregates, b)
+            # selection-vector execution: the filter chain becomes a
+            # live mask consumed by the aggregation (no compaction)
+            cols, live = self._masked_chain_eval(chain, b)
+            src = Batch(cols, jnp.sum(live.astype(jnp.int64)))
+            _p, _post, extra = _lower_aggregates(node.aggregates, src)
             if extra:
-                cols = dict(b.columns)
-                cols.update(extra)
-                b = Batch(cols, b.num_rows)
+                c2 = dict(src.columns)
+                c2.update(extra)
+                src = Batch(c2, src.num_rows)
             if node.group_keys:
-                return group_aggregate(b, list(node.group_keys), _p)
-            return _pad_partial(global_aggregate(b, _p))
+                return group_aggregate(src, list(node.group_keys), _p,
+                                       live=live)
+            return _pad_partial(global_aggregate(src, _p, live=live))
 
         # one jitted program serves every split (uniform capacities)
         run_jit = jax.jit(run) if self.fragment_jit else None
@@ -211,11 +231,9 @@ class Executor:
                 out = run(batch)
             partials.append(out)
         merged = device_concat(partials)
-        finals = [AggInput(
-            {"sum": "sum", "count": "sum", "count_star": "sum",
-             "min": "min", "max": "max",
-             "any_value": "any_value"}[a.kind], a.output, None, a.output)
-            for a in phys]
+        from ..ops.groupby import COMBINABLE_KINDS
+        finals = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
+                           a.output) for a in phys]
         if node.group_keys:
             out = group_aggregate(merged, list(node.group_keys), finals)
         else:
@@ -228,6 +246,98 @@ class Executor:
             cols = {s: c for s, c in cols.items() if s in keep}
             out = Batch(cols, out.num_rows)
         return out
+
+    # ------------------------------------------------------------------
+    # masked (selection-vector) filter -> aggregation fusion: filters
+    # below an aggregation become a liveness mask consumed directly by
+    # the aggregation kernels instead of a nonzero+gather compaction
+    # (reference keeps selected-positions arrays inside PageProcessor for
+    # the same reason — operator/project/PageProcessor.java; on TPU the
+    # compaction gather costs seconds at SF1 row counts, the mask is
+    # free)
+    # ------------------------------------------------------------------
+    def _masked_chain_eval(self, chain, b: Batch):
+        """Evaluate a Filter/Project/Sample chain over ``b`` WITHOUT
+        compacting: returns (columns, live-mask). Dead rows compute
+        garbage values that the downstream mask consumer ignores."""
+        live = b.row_valid()
+        cols = dict(b.columns)
+        cap = b.capacity
+        for nd in reversed(chain):
+            # num_rows=cap -> row_valid() is all-true inside expression
+            # eval; the real liveness is tracked in `live`
+            bb = Batch(cols, cap)
+            if isinstance(nd, FilterNode):
+                live = live & eval_predicate(nd.predicate, bb)
+            elif isinstance(nd, SampleNode):
+                from ..ops.hashing import mix64
+                h = mix64(jnp.arange(cap, dtype=jnp.uint64))
+                u = (h >> jnp.uint64(11)).astype(jnp.float64) \
+                    / float(1 << 53)
+                live = live & (u < nd.ratio)
+            else:
+                cols = {s: eval_expr(e, bb)
+                        for s, e in nd.assignments.items()}
+        return cols, live
+
+    def _try_masked_filter_aggregation(self, node: AggregationNode):
+        chain: List[PlanNode] = []
+        cur = node.source
+        while isinstance(cur, (FilterNode, ProjectNode, SampleNode)):
+            chain.append(cur)
+            cur = cur.source
+        if not any(isinstance(n, (FilterNode, SampleNode))
+                   for n in chain):
+            return None
+        base = self.execute(cur)
+
+        def run(b: Batch) -> Batch:
+            cols, live = self._masked_chain_eval(chain, b)
+            nlive = jnp.sum(live.astype(jnp.int64))
+            src = Batch(cols, nlive)
+            phys, post, extra_cols = _lower_aggregates(
+                node.aggregates, src)
+            if extra_cols:
+                c2 = dict(src.columns)
+                c2.update(extra_cols)
+                src = Batch(c2, nlive)
+            if node.group_keys:
+                out = group_aggregate(src, list(node.group_keys), phys,
+                                      live=live)
+            elif phys:
+                out = global_aggregate(src, phys, live=live)
+            else:
+                return _single_row(src)
+            if post:
+                oc = dict(out.columns)
+                for sym, fn in post.items():
+                    oc[sym] = fn(out)
+                keep = set(node.group_keys) | set(node.aggregates)
+                oc = {s: c for s, c in oc.items() if s in keep}
+                out = Batch(oc, out.num_rows)
+            return out
+
+        if not self.fragment_jit:
+            try:
+                return run(base)
+            except EvalError as e:
+                raise QueryError(str(e)) from e
+        key = ("masked", id(node))
+        if key in self._no_jit_chains:
+            return run(base)
+        jitted = self._jit_chains.get(key)
+        if jitted is None:
+            jitted = jax.jit(run)
+            self._jit_chains[key] = jitted
+        try:
+            return jitted(base)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # host-materializing expressions in the chain: run eagerly
+            self._no_jit_chains.add(key)
+            return run(base)
+        except EvalError as e:
+            raise QueryError(str(e)) from e
 
     def _dispatch_apply(self, node: PlanNode, src: Batch) -> Batch:
         try:
@@ -453,10 +563,17 @@ class Executor:
             eff = jnp.where(live_p, jnp.maximum(count, 1), 0) if outer \
                 else count
             total = int(jnp.sum(eff))
-            cap = capacity_for(total)
-            out = join_ops.expand_join(
-                left, right, start, count, order, cap,
-                "left" if outer else "inner")
+            width = len(left.columns) + len(right.columns)
+            if total > CONFIG.max_batch_rows:
+                out = self._oversized_join(
+                    left, right, start, count, eff, order, total,
+                    width, "left" if outer else "inner")
+            else:
+                self._reserve(total, width, "join output")
+                cap = capacity_for(total)
+                out = join_ops.expand_join(
+                    left, right, start, count, order, cap,
+                    "left" if outer else "inner")
             if jt == "full":
                 out = self._append_right_unmatched(
                     out, left, right, pkeys, bkeys)
@@ -471,12 +588,80 @@ class Executor:
         start, count, order = join_ops.match_counts(
             probe, build, pkeys, bkeys)
         total = int(jnp.sum(count))
+        width = len(probe.columns) + len(build.columns)
+        if total > CONFIG.max_batch_rows and jt == "inner":
+            out = self._oversized_join(probe, build, start, count, count,
+                                       order, total, width, "inner",
+                                       residual=filt)
+            return self._repair_outer(out, left, right, jt)
+        self._reserve(total, width, "join candidates")
         cap = capacity_for(total)
         cand = join_ops.expand_join(probe, build, start, count, order,
                                     cap, "inner")
         mask = eval_predicate(filt, cand)
         out = compact.filter_batch(cand, mask)
         return self._repair_outer(out, left, right, jt)
+
+    def _reserve(self, rows: int, n_lanes: int, what: str) -> None:
+        limit = int(self.session.get("query_max_memory_per_node"))
+        try:
+            reserve_bytes(rows, n_lanes, limit, what)
+        except MemoryLimitExceeded as e:
+            raise QueryError(str(e)) from e
+
+    def _oversized_join(self, probe: Batch, build: Batch, start, count,
+                        eff, order, total: int, width: int,
+                        jt: str, residual=None) -> Batch:
+        """Join whose output exceeds the per-batch device budget:
+        expand probe-row chunks device-side and accumulate the results
+        in HOST memory (the spiller role — reference:
+        operator/HashBuilderOperator.java:155-170 spill state machine /
+        spiller/GenericPartitioningSpiller; on TPU the spill target is
+        host RAM, the first rung of the HBM->host->disk ladder,
+        SURVEY.md §5 checkpoint/resume). Requires spill_enabled, else
+        the memory guard fires."""
+        if not bool(self.session.get("spill_enabled")):
+            self._reserve(total, width, "join output (spill disabled)")
+        eff_np = np.asarray(eff)
+        cum = np.cumsum(eff_np)
+        budget = CONFIG.max_batch_rows
+        n_live = probe.num_rows_host()
+        chunks: List[Batch] = []
+        lo = 0
+        consumed = 0
+        pcap = probe.capacity
+        while lo < pcap and consumed < total:
+            hi = int(np.searchsorted(cum, consumed + budget, "right"))
+            hi = max(hi, lo + 1)
+            chunk_rows = int(cum[hi - 1] - consumed)
+            if chunk_rows == 0:
+                lo = hi
+                continue
+            sel = jnp.arange(lo, hi, dtype=jnp.int64)
+            # gathered rows are live iff their original position was in
+            # the live prefix — gathered liveness is again a prefix
+            sub_probe = probe.gather(sel, max(min(n_live, hi) - lo, 0))
+            sub_start = jnp.take(jnp.asarray(start), sel)
+            sub_count = jnp.take(jnp.asarray(count), sel)
+            cap = capacity_for(max(chunk_rows, 1))
+            out = join_ops.expand_join(
+                sub_probe, build, sub_start, sub_count, order, cap, jt)
+            consumed += chunk_rows
+            lo = hi
+            if residual is not None:
+                # filter each chunk on device BEFORE spilling so only
+                # survivors reach host RAM
+                mask = eval_predicate(residual, out)
+                out = compact.filter_batch(out, mask)
+                chunk_rows = out.num_rows_host()
+                if chunk_rows == 0:
+                    continue
+            chunks.append(_to_host(out, chunk_rows))
+        if not chunks:
+            return _to_host(join_ops.expand_join(
+                probe, build, jnp.asarray(start),
+                jnp.zeros_like(jnp.asarray(count)), order, 8, jt), 0)
+        return _host_concat(chunks, sum(c.num_rows for c in chunks))
 
     def _cross_join(self, left: Batch, right: Batch, filt,
                     jt: str = "inner") -> Batch:
@@ -486,6 +671,8 @@ class Executor:
         sql/planner/plan/JoinNode.java; NestedLoopJoinOperator.java)."""
         nl, nr = left.num_rows_host(), right.num_rows_host()
         total = nl * nr
+        self._reserve(total, len(left.columns) + len(right.columns),
+                      "cross join output")
         cap = capacity_for(max(total, 1))
         probe = self._with_pos(left, _PPOS) if jt in ("left", "full") \
             else left
@@ -752,7 +939,10 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
 
     for sym, a in aggregates.items():
         kind = a.kind
-        if kind in ("sum", "min", "max", "count", "count_star"):
+        if kind == "count" and a.distinct:
+            phys.append(AggInput("count_distinct", a.argument, a.mask,
+                                 sym))
+        elif kind in ("sum", "min", "max", "count", "count_star"):
             phys.append(AggInput(kind, a.argument, a.mask, sym))
         elif kind in ("any_value", "arbitrary"):
             phys.append(AggInput("any_value", a.argument, a.mask, sym))
@@ -780,29 +970,178 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
             phys.append(AggInput(op, a.argument, a.mask, sym))
         elif kind in ("stddev", "stddev_samp", "stddev_pop", "variance",
                       "var_samp", "var_pop"):
-            arg = src.column(a.argument)
+            bsym, d, bvalid = _stat_lane(src, a.argument, extra,
+                                         sym + "$f")
             sqsym = sym + "$sq"
-            d = jnp.asarray(arg.data).astype(jnp.float64)
-            extra[sqsym] = Column(DOUBLE, d * d, arg.valid)
+            extra[sqsym] = Column(DOUBLE, d * d, bvalid)
             ssym, csym, s2sym = sym + "$s", sym + "$c", sym + "$s2"
-            phys.append(AggInput("sum", a.argument, a.mask, ssym))
-            phys.append(AggInput("count", a.argument, a.mask, csym))
+            phys.append(AggInput("sum", bsym, a.mask, ssym))
+            phys.append(AggInput("count", bsym, a.mask, csym))
             phys.append(AggInput("sum", sqsym, a.mask, s2sym))
             pop = kind.endswith("_pop")
             sqrt = kind.startswith("stddev")
             post[sym] = _variance_post(ssym, csym, s2sym, pop, sqrt)
         elif kind == "geometric_mean":
-            arg = src.column(a.argument)
             lsym = sym + "$ln"
-            d = jnp.asarray(arg.data).astype(jnp.float64)
-            extra[lsym] = Column(DOUBLE, jnp.log(d), arg.valid)
+            _, d, bvalid = _stat_lane(src, a.argument, extra, sym + "$f")
+            extra[lsym] = Column(DOUBLE, jnp.log(d), bvalid)
             ssym, csym = sym + "$s", sym + "$c"
             phys.append(AggInput("sum", lsym, a.mask, ssym))
             phys.append(AggInput("count", lsym, a.mask, csym))
             post[sym] = _geomean_post(ssym, csym)
+        elif kind in ("min_by", "max_by"):
+            phys.append(AggInput(
+                "argmin" if kind == "min_by" else "argmax",
+                a.argument, a.mask, sym, input2=a.argument2))
+        elif kind == "approx_distinct":
+            phys.append(AggInput("count_distinct", a.argument, a.mask,
+                                 sym))
+        elif kind == "approx_percentile":
+            phys.append(AggInput("percentile", a.argument, a.mask, sym,
+                                 param=a.param))
+        elif kind == "checksum":
+            # order-independent multiset hash: wraparound int64 sum of
+            # per-row hashes; NULL contributes a fixed odd constant
+            # (reference: operator/aggregation/ChecksumAggregation —
+            # xxhash64-based, ours is the engine hash of ops/hashing.py)
+            from ..ops.hashing import hash_column as _hcol, mix64 as _mix
+            arg = src.column(a.argument)
+            hsym = sym + "$h"
+            h = _hcol(arg.data, arg.valid)
+            if arg.data2 is not None:
+                h = h * jnp.uint64(31) + _hcol(arg.data2, arg.valid)
+            valid_row = (jnp.ones((h.shape[0],), bool)
+                         if arg.valid is None else jnp.asarray(arg.valid))
+            h = jnp.where(valid_row, h,
+                          jnp.uint64(0x9E3779B97F4A7C15))
+            extra[hsym] = Column(BIGINT, h.astype(jnp.int64), None)
+            phys.append(AggInput("sum", hsym, a.mask, sym))
+        elif kind in ("corr", "covar_samp", "covar_pop", "regr_slope",
+                      "regr_intercept"):
+            # sum-of-products lowering over PAIRWISE-valid rows
+            # (reference: CovarianceAggregation / CorrelationAggregation
+            # / RegressionAggregation states)
+            _, yd, yv = _stat_lane(src, a.argument, extra, sym + "$fy")
+            _, xd, xv = _stat_lane(src, a.argument2, extra, sym + "$fx")
+            pv = None
+            for v in (yv, xv):
+                if v is not None:
+                    v = jnp.asarray(v)
+                    pv = v if pv is None else pv & v
+            names = {}
+            lanes = {"y": yd, "x": xd, "xy": xd * yd, "xx": xd * xd}
+            if kind == "corr":
+                lanes["yy"] = yd * yd
+            for tag, d in lanes.items():
+                lsym = f"{sym}${tag}"
+                extra[lsym] = Column(DOUBLE, d, pv)
+                ssym = f"{sym}$s{tag}"
+                phys.append(AggInput("sum", lsym, a.mask, ssym))
+                names[tag] = ssym
+            csym = sym + "$n"
+            phys.append(AggInput("count", f"{sym}$x", a.mask, csym))
+            post[sym] = _bivariate_post(kind, names, csym)
+        elif kind in ("skewness", "kurtosis"):
+            bsym, d, bvalid = _stat_lane(src, a.argument, extra,
+                                         sym + "$f")
+            names = {}
+            for p, tag in ((2, "2"), (3, "3"), (4, "4")):
+                if p == 4 and kind != "kurtosis":
+                    continue
+                lsym = f"{sym}$p{tag}"
+                extra[lsym] = Column(DOUBLE, d ** p, bvalid)
+                ssym = f"{sym}$s{tag}"
+                phys.append(AggInput("sum", lsym, a.mask, ssym))
+                names[tag] = ssym
+            ssym, csym = sym + "$s1", sym + "$n"
+            phys.append(AggInput("sum", bsym, a.mask, ssym))
+            phys.append(AggInput("count", bsym, a.mask, csym))
+            post[sym] = _moments_post(kind, ssym, names, csym)
         else:
             raise QueryError(f"aggregate '{kind}' not implemented")
     return phys, post, extra
+
+
+def _stat_lane(src: Batch, name: str, extra: Dict[str, Column],
+               tag: str):
+    """(symbol, f64 lane, validity) of a numeric input for the
+    statistical aggregates — DECIMAL lanes are unscaled to doubles
+    (their storage is the scaled integer)."""
+    col = src.column(name)
+    d = jnp.asarray(col.data).astype(jnp.float64)
+    if isinstance(col.type, DecimalType):
+        if col.data2 is not None:
+            raise QueryError(
+                "statistical aggregates over DECIMAL(p>18) are not "
+                "supported")
+        d = d / (10.0 ** col.type.scale)
+        extra[tag] = Column(DOUBLE, d, col.valid)
+        return tag, d, col.valid
+    return name, d, col.valid
+
+
+def _bivariate_post(kind: str, s: Dict[str, str], csym: str):
+    """corr/covar/regr finishers from pairwise sums. Formulas match the
+    reference accumulator states (CovarianceState etc.)."""
+    def fn(out: Batch) -> Column:
+        n = jnp.asarray(out.column(csym).data).astype(jnp.float64)
+        sy = jnp.asarray(out.column(s["y"]).data).astype(jnp.float64)
+        sx = jnp.asarray(out.column(s["x"]).data).astype(jnp.float64)
+        sxy = jnp.asarray(out.column(s["xy"]).data).astype(jnp.float64)
+        sxx = jnp.asarray(out.column(s["xx"]).data).astype(jnp.float64)
+        nn = jnp.maximum(n, 1.0)
+        co = sxy - sx * sy / nn          # n * cov_pop
+        mxx = sxx - sx * sx / nn         # n * var_pop(x)
+        if kind == "covar_pop":
+            data, valid = co / nn, n > 0
+        elif kind == "covar_samp":
+            data, valid = co / jnp.maximum(n - 1.0, 1.0), n > 1
+        elif kind == "corr":
+            syy = jnp.asarray(out.column(s["yy"]).data).astype(
+                jnp.float64)
+            myy = syy - sy * sy / nn
+            denom = jnp.sqrt(mxx * myy)
+            data = co / jnp.where(denom > 0.0, denom, 1.0)
+            valid = (n > 1) & (denom > 0.0)
+        elif kind == "regr_slope":
+            data = co / jnp.where(mxx > 0.0, mxx, 1.0)
+            valid = (n > 0) & (mxx > 0.0)
+        else:  # regr_intercept
+            slope = co / jnp.where(mxx > 0.0, mxx, 1.0)
+            data = (sy - slope * sx) / nn
+            valid = (n > 0) & (mxx > 0.0)
+        return Column(DOUBLE, data, valid)
+    return fn
+
+
+def _moments_post(kind: str, ssym: str, s: Dict[str, str], csym: str):
+    """skewness/kurtosis from raw power sums via central moments
+    (reference: CentralMomentsState + DoubleSkewness/Kurtosis)."""
+    def fn(out: Batch) -> Column:
+        n = jnp.asarray(out.column(csym).data).astype(jnp.float64)
+        s1 = jnp.asarray(out.column(ssym).data).astype(jnp.float64)
+        s2 = jnp.asarray(out.column(s["2"]).data).astype(jnp.float64)
+        s3 = jnp.asarray(out.column(s["3"]).data).astype(jnp.float64)
+        nn = jnp.maximum(n, 1.0)
+        m2 = s2 - s1 * s1 / nn
+        m3 = s3 - 3.0 * s1 * s2 / nn + 2.0 * s1 ** 3 / (nn * nn)
+        if kind == "skewness":
+            denom = jnp.where(m2 > 0.0, m2, 1.0) ** 1.5
+            data = jnp.sqrt(nn) * m3 / denom
+            valid = (n > 2) & (m2 > 0.0)
+        else:
+            s4 = jnp.asarray(out.column(s["4"]).data).astype(jnp.float64)
+            m4 = (s4 - 4.0 * s1 * s3 / nn + 6.0 * s1 * s1 * s2 / (nn * nn)
+                  - 3.0 * s1 ** 4 / (nn ** 3))
+            m2s = jnp.where(m2 > 0.0, m2, 1.0)
+            data = (nn * (nn + 1.0) / jnp.maximum(
+                (nn - 1.0) * (nn - 2.0) * (nn - 3.0), 1.0)
+                * (nn * m4 / (m2s * m2s))
+                - 3.0 * (nn - 1.0) ** 2 / jnp.maximum(
+                    (nn - 2.0) * (nn - 3.0), 1.0))
+            valid = (n > 3) & (m2 > 0.0)
+        return Column(DOUBLE, data, valid)
+    return fn
 
 
 def _avg_post(ssym, csym, rtype):
@@ -849,6 +1188,62 @@ def _geomean_post(ssym, csym):
 
 
 # --------------------------------------------------------------------------
+# host spill helpers (HBM -> host RAM accumulation for oversized joins)
+# --------------------------------------------------------------------------
+
+def _to_host(b: Batch, n: int) -> Batch:
+    """Materialize the live prefix of ``b`` on host (numpy lanes) —
+    the spill write. LazyBlock in reverse: device memory is released,
+    re-upload happens lazily when a kernel touches the column."""
+    cols = {}
+    for s, c in b.columns.items():
+        data = np.asarray(c.data)[:n].copy()
+        valid = None if c.valid is None else np.asarray(c.valid)[:n].copy()
+        d2 = None if c.data2 is None else np.asarray(c.data2)[:n].copy()
+        cols[s] = Column(c.type, data, valid, c.dictionary, d2)
+    return Batch(cols, n)
+
+
+def _host_concat(chunks: Sequence[Batch], total: int) -> Batch:
+    """Concatenate host-resident chunks into one host Batch."""
+    cap = capacity_for(max(total, 1), minimum=8)
+    names = chunks[0].names
+    cols: Dict[str, Column] = {}
+    for name in names:
+        cs = [c.column(name) for c in chunks]
+        typ = cs[0].type
+        dic = cs[0].dictionary
+        if dic is not None and any(c.dictionary is not dic
+                                   for c in cs[1:]):
+            merged = dic
+            remaps = [np.arange(len(merged), dtype=np.int32)]
+            for c in cs[1:]:
+                merged, _, ro = merged.merge(c.dictionary)
+                remaps.append(ro)
+            lanes = [np.take(rm, np.asarray(c.data).astype(np.int32))
+                     for c, rm in zip(cs, remaps)]
+            dic = merged
+        else:
+            lanes = [np.asarray(c.data) for c in cs]
+        data = np.concatenate(lanes)
+        data = np.pad(data, (0, cap - len(data)))
+        valid = None
+        if any(c.valid is not None for c in cs):
+            vl = [np.ones(len(np.asarray(c.data)), bool)
+                  if c.valid is None else np.asarray(c.valid)
+                  for c in cs]
+            valid = np.pad(np.concatenate(vl), (0, cap - total))
+        d2 = None
+        if any(c.data2 is not None for c in cs):
+            l2 = [np.zeros(len(np.asarray(c.data)), np.int64)
+                  if c.data2 is None else np.asarray(c.data2)
+                  for c in cs]
+            d2 = np.pad(np.concatenate(l2), (0, cap - total))
+        cols[name] = Column(typ, data, valid, dic, d2)
+    return Batch(cols, total)
+
+
+# --------------------------------------------------------------------------
 # device concat (local exchange merge)
 # --------------------------------------------------------------------------
 
@@ -863,6 +1258,13 @@ def device_concat(parts: Sequence[Batch]) -> Batch:
         return parts[0]
     counts = [p.num_rows_host() for p in parts]
     total = sum(counts)
+    if total > CONFIG.max_batch_rows and any(
+            isinstance(next(iter(p.columns.values())).data, np.ndarray)
+            for p in parts):
+        # an oversized part already spilled to host: keep the merge on
+        # host RAM instead of re-materializing everything on device
+        return _host_concat([_to_host(p, n)
+                             for p, n in zip(parts, counts)], total)
     cap = capacity_for(max(total, 1))
     names = parts[0].names
     out_cols: Dict[str, Column] = {}

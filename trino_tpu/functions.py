@@ -24,7 +24,7 @@ AGGREGATE_NAMES = {
     "stddev_pop", "variance", "var_samp", "var_pop", "geometric_mean",
     "approx_distinct", "min_by", "max_by", "array_agg", "checksum",
     "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
-    "skewness", "kurtosis",
+    "skewness", "kurtosis", "approx_percentile",
 }
 
 WINDOW_ONLY_NAMES = {
@@ -45,7 +45,8 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
         if isinstance(t, DecimalType):
             return DecimalType(38, t.scale)
         return t
-    if name in ("min", "max", "any_value", "arbitrary"):
+    if name in ("min", "max", "any_value", "arbitrary",
+                "approx_percentile"):
         return t
     if name in ("min_by", "max_by"):
         return t

@@ -38,12 +38,23 @@ class AggInput:
     mask: Optional[str] = None    # FILTER / mask column (boolean), optional
     output: str = "agg"
     param: Optional[float] = None  # percentile fraction for 'percentile'
+    input2: Optional[str] = None   # comparator lane for argmin/argmax
 
 
-def _key_lanes(batch: Batch, key_names: Sequence[str]) -> List[jax.Array]:
+# kinds whose partials combine with another single-lane segment op —
+# these support the PARTIAL -> exchange -> FINAL plan split (reference:
+# PushPartialAggregationThroughExchange); the rest (argmin/argmax,
+# count_distinct, percentile) need all rows of a group co-located, i.e.
+# repartition-BEFORE-aggregate
+COMBINABLE_KINDS = {"sum": "sum", "count": "sum", "count_star": "sum",
+                    "min": "min", "max": "max", "any_value": "any_value"}
+
+
+def _key_lanes(batch: Batch, key_names: Sequence[str],
+               live: Optional[jax.Array] = None) -> List[jax.Array]:
     """Exact equality-preserving lanes; a null is its own group value
     (SQL GROUP BY treats NULLs as equal), encoded via a validity lane."""
-    live = batch.row_valid()
+    live = batch.row_valid() if live is None else live
     lanes: List[jax.Array] = [(~live).astype(jnp.uint64)]
     for name in key_names:
         col = batch.column(name)
@@ -62,6 +73,21 @@ def _key_lanes(batch: Batch, key_names: Sequence[str]) -> List[jax.Array]:
     return lanes
 
 
+def _string_minmax_lane(col: Column, vals: jax.Array, kind: str):
+    """(rank lane, identity, decode) for MIN/MAX over a dictionary
+    column: reduce over collation ranks, decode the winning rank back
+    to a code (codes are insertion-ordered, not collation-ordered)."""
+    ranks = col.dictionary.rank_codes()
+    code_by_rank = jnp.asarray(_invert_permutation(ranks))
+    rvals = jnp.take(jnp.asarray(ranks), vals, mode="clip")
+    ident = jnp.asarray(len(ranks) if kind == "min" else -1, rvals.dtype)
+
+    def decode(data):
+        return jnp.take(code_by_rank, jnp.clip(data, 0, len(ranks) - 1),
+                        mode="clip").astype(jnp.int32)
+    return rvals, ident, decode
+
+
 def _identity_for(kind: str, dtype) -> jax.Array:
     if dtype == jnp.bool_:
         return jnp.asarray(kind == "min", dtype)
@@ -76,9 +102,173 @@ def _identity_for(kind: str, dtype) -> jax.Array:
     return jnp.asarray(0, dtype)
 
 
+# largest packed key-domain the unrolled masked-reduction kernel will
+# take on; beyond this the lexsort path wins (graph size / compile time)
+FAST_DOMAIN_LIMIT = 64
+
+_FAST_KINDS = {"sum", "count", "count_star", "min", "max", "any_value"}
+
+
+def _static_domain(col: Column) -> Optional[int]:
+    """Statically-known value domain [0, d): dictionary code range or
+    bool. None when unknown (general ints/floats)."""
+    if col.dictionary is not None:
+        return len(col.dictionary)
+    if jnp.asarray(col.data).dtype == jnp.bool_:
+        return 2
+    return None
+
+
+def _packed_group_aggregate(batch: Batch, key_names: Sequence[str],
+                            aggs: Sequence[AggInput], gcap: int,
+                            live: Optional[jax.Array] = None
+                            ) -> Optional[Batch]:
+    """Small-static-domain GROUP BY: one packed int32 group id per row,
+    every aggregate an unrolled per-group masked reduction (VPU-friendly,
+    single fused pass over HBM)."""
+    doms: List[int] = []
+    kcols: List[Column] = []
+    if not key_names:
+        return None
+    for name in key_names:
+        c = batch.column(name)
+        d = _static_domain(c)
+        if d is None or c.data2 is not None:
+            return None
+        doms.append(d)
+        kcols.append(c)
+    nseg = 1
+    for d in doms:
+        nseg *= d + 1          # one extra slot per key for NULL
+    if nseg > FAST_DOMAIN_LIMIT or nseg > gcap:
+        return None
+    if any(a.kind not in _FAST_KINDS for a in aggs):
+        return None
+
+    cap = batch.capacity
+    if live is None:
+        live = batch.row_valid()
+    packed = jnp.zeros((cap,), jnp.int32)
+    for c, d in zip(kcols, doms):
+        code = jnp.asarray(c.data).astype(jnp.int32)
+        code = jnp.clip(code, 0, d - 1)
+        if c.valid is not None:
+            code = jnp.where(jnp.asarray(c.valid), code, d)
+        packed = packed * (d + 1) + code
+
+    gmasks = [live & (packed == g) for g in range(nseg)]
+    counts = jnp.stack([jnp.sum(m.astype(jnp.int64)) for m in gmasks])
+
+    out_cols: Dict[str, Column] = {}
+    # key columns decoded from the group index (after compaction below)
+    exists = counts > 0
+    num_groups = jnp.sum(exists.astype(jnp.int64))
+    gidx = jnp.nonzero(exists, size=gcap, fill_value=nseg)[0]
+
+    rem = gidx
+    for name, c, d in zip(reversed(key_names), reversed(kcols),
+                          reversed(doms)):
+        code = (rem % (d + 1)).astype(jnp.int32)
+        rem = rem // (d + 1)
+        is_null = code >= d
+        data = jnp.clip(code, 0, d - 1)
+        if jnp.asarray(c.data).dtype == jnp.bool_:
+            data = data.astype(jnp.bool_)
+        valid = ~is_null if c.valid is not None else None
+        out_cols[name] = Column(c.type, data, valid, c.dictionary)
+    out_cols = {k: out_cols[k] for k in key_names}
+
+    gidx_c = jnp.clip(gidx, 0, nseg - 1)
+    for agg in aggs:
+        res = _masked_agg(batch, agg, gmasks, live, nseg)
+        out_cols[agg.output] = _compact_groups(res, gidx_c)
+
+    return Batch(out_cols, num_groups)
+
+
+def _compact_groups(col: Column, gidx: jax.Array) -> Column:
+    from dataclasses import replace as _replace
+    data = jnp.take(jnp.asarray(col.data), gidx, mode="clip")
+    valid = (None if col.valid is None
+             else jnp.take(jnp.asarray(col.valid), gidx, mode="clip"))
+    data2 = (None if col.data2 is None
+             else jnp.take(jnp.asarray(col.data2), gidx, mode="clip"))
+    return _replace(col, data=data, valid=valid, data2=data2)
+
+
+def _masked_agg(batch: Batch, agg: AggInput, gmasks, live,
+                nseg: int) -> Column:
+    """One aggregate as nseg masked reductions -> [nseg] arrays."""
+    from ..types import BIGINT, is_string
+
+    if agg.mask is not None:
+        mcol = batch.column(agg.mask)
+        m = jnp.asarray(mcol.data).astype(bool)
+        if mcol.valid is not None:
+            m = m & jnp.asarray(mcol.valid)
+        gmasks = [g & m for g in gmasks]
+
+    if agg.kind == "count_star":
+        data = jnp.stack([jnp.sum(g.astype(jnp.int64)) for g in gmasks])
+        return Column(BIGINT, data, None)
+
+    col = batch.column(agg.input)
+    if col.data2 is not None and agg.kind in ("sum", "min", "max"):
+        raise NotImplementedError(
+            f"{agg.kind} over DECIMAL(p>18) is not supported yet")
+    vals = jnp.asarray(col.data)
+    if col.valid is not None:
+        v = jnp.asarray(col.valid)
+        gmasks = [g & v for g in gmasks]
+
+    if agg.kind == "count":
+        data = jnp.stack([jnp.sum(g.astype(jnp.int64)) for g in gmasks])
+        return Column(BIGINT, data, None)
+
+    nvalid = jnp.stack([jnp.sum(g.astype(jnp.int64)) for g in gmasks])
+    group_valid = nvalid > 0
+
+    if agg.kind == "sum":
+        acc_dtype = vals.dtype if vals.dtype in (
+            jnp.float32, jnp.float64) else jnp.int64
+        av = vals.astype(acc_dtype)
+        zero = jnp.asarray(0, acc_dtype)
+        data = jnp.stack(
+            [jnp.sum(jnp.where(g, av, zero)) for g in gmasks])
+        return Column(_sum_type(col.type), data, group_valid)
+
+    if agg.kind in ("min", "max"):
+        red = jnp.min if agg.kind == "min" else jnp.max
+        if is_string(col.type):
+            rvals, ident, decode = _string_minmax_lane(col, vals,
+                                                       agg.kind)
+            data = decode(jnp.stack(
+                [red(jnp.where(g, rvals, ident)) for g in gmasks]))
+            return Column(col.type, data, group_valid,
+                          dictionary=col.dictionary)
+        as_bool = vals.dtype == jnp.bool_
+        work = vals.astype(jnp.int32) if as_bool else vals
+        ident = _identity_for(agg.kind, work.dtype)
+        data = jnp.stack(
+            [red(jnp.where(g, work, ident)) for g in gmasks])
+        if as_bool:
+            data = data.astype(jnp.bool_)
+        return Column(col.type, data, group_valid)
+
+    # any_value: first valid row per group
+    cap = vals.shape[0]
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    firsts = jnp.stack(
+        [jnp.min(jnp.where(g, pos, jnp.int64(cap))) for g in gmasks])
+    from dataclasses import replace as _replace
+    out = col.gather(jnp.clip(firsts, 0, cap - 1))
+    return _replace(out, valid=group_valid)
+
+
 def group_aggregate(batch: Batch, key_names: Sequence[str],
                     aggs: Sequence[AggInput],
-                    groups_capacity: Optional[int] = None) -> Batch:
+                    groups_capacity: Optional[int] = None,
+                    live: Optional[jax.Array] = None) -> Batch:
     """GROUP BY key_names with the given aggregates.
 
     Returns a Batch of key columns + aggregate columns, capacity-padded to
@@ -86,12 +276,29 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     Aggregate null semantics: sum/min/max over zero non-null inputs yield
     NULL; count yields 0 (SQL standard, matching reference
     operator/aggregation/LongSumAggregation.java).
+
+    ``live`` overrides the batch's prefix liveness with an explicit row
+    mask (selection-vector execution: a fused upstream filter passes its
+    mask here instead of compacting — compaction's nonzero+gather costs
+    seconds at SF1 row counts on TPU).
+
+    Two kernels (the BigintGroupByHash / MultiChannelGroupByHash split of
+    the reference, re-specialized for TPU):
+    - packed fast path when every key has a small STATIC domain
+      (dictionary codes, bools): group id = packed key, aggregates =
+      unrolled masked reductions — no sort, no gather, no scatter, which
+      are all pathologically slow on TPU (measured v5e: lexsort 2.5s,
+      take 5.1s, segment_sum 0.6s vs masked reduction 29ms at 8M rows).
+    - general path: stable lexsort on key lanes + segment ops.
     """
     cap = batch.capacity
     gcap = groups_capacity or cap
-    live = batch.row_valid()
+    fast = _packed_group_aggregate(batch, key_names, aggs, gcap, live)
+    if fast is not None:
+        return fast
+    live = batch.row_valid() if live is None else live
 
-    lanes = _key_lanes(batch, key_names)
+    lanes = _key_lanes(batch, key_names, live)
     order = jnp.lexsort(lanes[::-1])
     live_s = jnp.take(live, order)
 
@@ -116,13 +323,13 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
 
     for agg in aggs:
         out_cols[agg.output] = _segment_agg(
-            batch, agg, order, gid_c, live_s, gcap, lanes)
+            batch, agg, order, gid_c, live_s, gcap, lanes, live)
 
     return Batch(out_cols, num_groups)
 
 
 def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
-                 gcap: int, key_lanes=None) -> Column:
+                 gcap: int, key_lanes=None, live_u=None) -> Column:
     from ..types import BIGINT, DOUBLE, is_string
 
     extra_mask = None
@@ -174,19 +381,10 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         seg = jax.ops.segment_min if agg.kind == "min" else \
             jax.ops.segment_max
         if is_string(col.type):
-            # min/max over collation ranks, then rank -> code
-            # (codes are insertion-ordered, not collation-ordered)
-            ranks = col.dictionary.rank_codes()
-            code_by_rank = jnp.asarray(
-                _invert_permutation(ranks))
-            rvals = jnp.take(jnp.asarray(ranks), vals, mode="clip")
-            ident = jnp.asarray(
-                len(ranks) if agg.kind == "min" else -1, rvals.dtype)
-            data = seg(jnp.where(valid, rvals, ident), gid,
-                       num_segments=gcap)
-            data = jnp.take(code_by_rank,
-                            jnp.clip(data, 0, len(ranks) - 1),
-                            mode="clip").astype(jnp.int32)
+            rvals, ident, decode = _string_minmax_lane(col, vals,
+                                                       agg.kind)
+            data = decode(seg(jnp.where(valid, rvals, ident), gid,
+                              num_segments=gcap))
             return Column(col.type, data, group_valid,
                           dictionary=col.dictionary)
         as_bool = vals.dtype == jnp.bool_
@@ -209,7 +407,141 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         from dataclasses import replace as _replace
         return _replace(col.gather(rows), valid=group_valid)
 
+    if agg.kind in ("argmin", "argmax"):
+        # min_by/max_by: the value of `input` at the row where `input2`
+        # is extreme (reference: operator/aggregation/
+        # MinMaxByAggregationFunction.java). Two segment passes: the
+        # extreme comparator, then the first row attaining it.
+        from dataclasses import replace as _replace
+        cap = order.shape[0]
+        comp = batch.column(agg.input2)
+        if comp.data2 is not None:
+            raise NotImplementedError(
+                f"{agg.kind} by DECIMAL(p>18) is not supported yet")
+        cvalid = live_s if comp.valid is None else (
+            live_s & jnp.take(jnp.asarray(comp.valid), order))
+        if extra_mask is not None:
+            cvalid = cvalid & extra_mask
+        work, _ = _order_lane(comp, order)
+        lo = agg.kind == "argmin"
+        ident = _identity_for("min" if lo else "max", work.dtype)
+        work = jnp.where(cvalid & ~_isnan(work), work, ident)
+        seg = jax.ops.segment_min if lo else jax.ops.segment_max
+        ext = seg(work, gid, num_segments=gcap)
+        cand = cvalid & (work == jnp.take(ext, gid))
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        first = jax.ops.segment_min(
+            jnp.where(cand, pos, jnp.int64(cap)), gid, num_segments=gcap)
+        rows = jnp.take(order, jnp.clip(first, 0, cap - 1))
+        gv = jax.ops.segment_sum(cvalid.astype(jnp.int64), gid,
+                                 num_segments=gcap) > 0
+        out = col.gather(rows)
+        ov = gv if out.valid is None else gv & jnp.asarray(out.valid)
+        return _replace(out, valid=ov)
+
+    if agg.kind in ("count_distinct", "percentile"):
+        return _resorted_agg(batch, agg, col, gid, live_s, gcap,
+                             key_lanes, extra_mask, order, live_u)
+
     raise ValueError(f"unknown aggregate kind {agg.kind}")
+
+
+def _isnan(x: jax.Array) -> jax.Array:
+    if x.dtype in (jnp.float32, jnp.float64):
+        return jnp.isnan(x)
+    return jnp.zeros(x.shape, bool)
+
+
+def _order_lane(col: Column, order=None) -> Tuple[jax.Array, object]:
+    """A single lane whose numeric order == the SQL order of the column
+    (collation ranks for strings, int32 for bools); second return is the
+    rank->code decoder (strings only)."""
+    from ..types import is_string
+    d = jnp.asarray(col.data)
+    decoder = None
+    if is_string(col.type):
+        ranks = col.dictionary.rank_codes()
+        decoder = jnp.asarray(_invert_permutation(ranks))
+        d = jnp.take(jnp.asarray(ranks), d, mode="clip").astype(jnp.int32)
+    elif d.dtype == jnp.bool_:
+        d = d.astype(jnp.int32)
+    if order is not None:
+        d = jnp.take(d, order)
+    return d, decoder
+
+
+def _resorted_agg(batch: Batch, agg: AggInput, col: Column, gid, live_s,
+                  gcap: int, key_lanes, extra_mask, order,
+                  live_u=None) -> Column:
+    """Aggregates that need rows RE-sorted by (keys, value): exact
+    count_distinct (reference approximates with HLL —
+    ApproximateCountDistinctAggregation.java; exact is a superset) and
+    exact percentile (reference: qdigest approx_percentile). Group ids
+    stay aligned with the primary sort because both orders sort by the
+    key lanes first."""
+    from ..types import BIGINT
+    cap = order.shape[0]
+    live = batch.row_valid() if live_u is None else live_u
+    valid_u = live if col.valid is None else live & jnp.asarray(col.valid)
+    if agg.mask is not None:
+        mcol = batch.column(agg.mask)
+        m = jnp.asarray(mcol.data).astype(bool)
+        if mcol.valid is not None:
+            m = m & jnp.asarray(mcol.valid)
+        valid_u = valid_u & m
+
+    if agg.kind == "count_distinct":
+        vlanes = equality_lanes(col.data)
+        if col.data2 is not None:
+            vlanes = vlanes + equality_lanes(col.data2)
+        vlanes = [jnp.where(valid_u, u, jnp.zeros_like(u))
+                  for u in vlanes]
+        tie = [(~valid_u).astype(jnp.uint64)] + vlanes
+    else:
+        if col.data2 is not None:
+            raise NotImplementedError(
+                "percentile over DECIMAL(p>18) is not supported yet")
+        olane, _ = _order_lane(col)
+        tie = [(~valid_u).astype(jnp.uint64), olane]
+
+    full = list(key_lanes) + tie
+    order2 = jnp.lexsort(full[::-1])
+    live_s2 = jnp.take(live, order2)
+    changed_k = jnp.zeros((cap,), dtype=bool)
+    for lane in key_lanes[1:]:
+        s = jnp.take(lane, order2)
+        changed_k = changed_k | (s != jnp.roll(s, 1))
+    first = jnp.arange(cap) == 0
+    boundary2 = (changed_k | first) & live_s2
+    gid2 = jnp.clip(jnp.cumsum(boundary2.astype(jnp.int64)) - 1,
+                    0, gcap - 1).astype(jnp.int32)
+    valid2 = jnp.take(valid_u, order2)
+
+    if agg.kind == "count_distinct":
+        changed_v = changed_k
+        for lane in tie:
+            s = jnp.take(lane, order2)
+            changed_v = changed_v | (s != jnp.roll(s, 1))
+        newval = (changed_v | first) & valid2
+        data = jax.ops.segment_sum(newval.astype(jnp.int64), gid2,
+                                   num_segments=gcap)
+        return Column(BIGINT, data, None)
+
+    # exact percentile: valid rows of each group are a contiguous
+    # ascending run starting at the group boundary (invalids sort last
+    # within the group); pick the nearest-rank element
+    from dataclasses import replace as _replace
+    pos = jnp.arange(cap, dtype=jnp.int64)
+    start = jax.ops.segment_min(
+        jnp.where(live_s2, pos, jnp.int64(cap)), gid2, num_segments=gcap)
+    nvalid = jax.ops.segment_sum(valid2.astype(jnp.int64), gid2,
+                                 num_segments=gcap)
+    q = float(agg.param if agg.param is not None else 0.5)
+    k = jnp.clip(jnp.floor(q * (nvalid - 1).astype(jnp.float64) + 0.5)
+                 .astype(jnp.int64), 0, jnp.maximum(nvalid - 1, 0))
+    rows = jnp.take(order2, jnp.clip(start + k, 0, cap - 1))
+    out = col.gather(rows)
+    return _replace(out, valid=nvalid > 0)
 
 
 def _invert_permutation(ranks):
@@ -230,12 +562,14 @@ def _sum_type(t):
     return DOUBLE
 
 
-def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
+def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
+                     live: Optional[jax.Array] = None) -> Batch:
     """Aggregation without GROUP BY (reference: operator/
-    AggregationOperator.java) — masked full reductions, one output row."""
+    AggregationOperator.java) — masked full reductions, one output row.
+    ``live`` as in group_aggregate (selection-vector input)."""
     from ..types import BIGINT
 
-    live = batch.row_valid()
+    live = batch.row_valid() if live is None else live
     out: Dict[str, Column] = {}
     for agg in aggs:
         extra = None
@@ -271,17 +605,12 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
         elif agg.kind in ("min", "max"):
             from ..types import is_string as _is_str
             if _is_str(col.type):
-                ranks = col.dictionary.rank_codes()
-                code_by_rank = jnp.asarray(_invert_permutation(ranks))
-                rvals = jnp.take(jnp.asarray(ranks), vals, mode="clip")
-                ident = jnp.asarray(
-                    len(ranks) if agg.kind == "min" else -1, rvals.dtype)
+                rvals, ident, decode = _string_minmax_lane(
+                    col, vals, agg.kind)
                 masked = jnp.where(valid, rvals, ident)
                 r = (jnp.min(masked) if agg.kind == "min"
                      else jnp.max(masked))
-                r = jnp.take(code_by_rank,
-                             jnp.clip(r, 0, len(ranks) - 1),
-                             mode="clip").astype(jnp.int32)[None]
+                r = decode(r)[None]
                 out[agg.output] = Column(col.type, r, has,
                                          dictionary=col.dictionary)
             else:
@@ -298,6 +627,53 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput]) -> Batch:
             from dataclasses import replace as _replace
             idx = jnp.argmax(valid)  # first valid row (0 if none)
             out[agg.output] = _replace(col.gather(idx[None]), valid=has)
+        elif agg.kind in ("argmin", "argmax"):
+            from dataclasses import replace as _replace
+            comp = batch.column(agg.input2)
+            if comp.data2 is not None:
+                raise NotImplementedError(
+                    f"{agg.kind} by DECIMAL(p>18) is not supported yet")
+            cvalid = live if comp.valid is None else (
+                live & jnp.asarray(comp.valid))
+            if extra is not None:
+                cvalid = cvalid & extra
+            work, _ = _order_lane(comp)
+            lo = agg.kind == "argmin"
+            ident = _identity_for("min" if lo else "max", work.dtype)
+            work = jnp.where(cvalid & ~_isnan(work), work, ident)
+            idx = jnp.argmin(work) if lo else jnp.argmax(work)
+            gv = jnp.any(cvalid)[None]
+            res = col.gather(idx[None])
+            ov = gv if res.valid is None else gv & jnp.asarray(res.valid)
+            out[agg.output] = _replace(res, valid=ov)
+        elif agg.kind == "count_distinct":
+            vlanes = equality_lanes(col.data)
+            if col.data2 is not None:
+                vlanes = vlanes + equality_lanes(col.data2)
+            vlanes = [jnp.where(valid, u, jnp.zeros_like(u))
+                      for u in vlanes]
+            full = [(~valid).astype(jnp.uint64)] + vlanes
+            order2 = jnp.lexsort(full[::-1])
+            valid2 = jnp.take(valid, order2)
+            changed = jnp.arange(batch.capacity) == 0
+            for lane in vlanes:
+                s = jnp.take(lane, order2)
+                changed = changed | (s != jnp.roll(s, 1))
+            cnt = jnp.sum((changed & valid2).astype(jnp.int64))
+            out[agg.output] = Column(BIGINT, cnt[None], None)
+        elif agg.kind == "percentile":
+            from dataclasses import replace as _replace
+            if col.data2 is not None:
+                raise NotImplementedError(
+                    "percentile over DECIMAL(p>18) is not supported yet")
+            olane, _ = _order_lane(col)
+            full = [(~valid).astype(jnp.uint64), olane]
+            order2 = jnp.lexsort(full[::-1])
+            q = float(agg.param if agg.param is not None else 0.5)
+            k = jnp.clip(jnp.floor(q * (n - 1).astype(jnp.float64) + 0.5)
+                         .astype(jnp.int64), 0, jnp.maximum(n - 1, 0))
+            rows = jnp.take(order2, k[None])
+            out[agg.output] = _replace(col.gather(rows), valid=has)
         else:
             raise ValueError(f"unknown aggregate kind {agg.kind}")
     return Batch(out, 1)

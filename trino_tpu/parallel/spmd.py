@@ -164,27 +164,37 @@ def distributed_group_aggregate(sb: ShardedBatch,
     (SURVEY.md §2.7 partial/final row) as one SPMD program: every
     aggregate below declares a combine that is itself a segment op,
     so the partial output columns feed the final step directly."""
+    from ..ops.groupby import COMBINABLE_KINDS
     n = sb.n_shards
     partial_cap = sb.per_shard_cap
     exch_cap = n * partial_cap if out_cap is None else out_cap
 
-    finals: List[AggInput] = []
-    for a in aggs:
-        combine = {"sum": "sum", "count": "sum", "count_star": "sum",
-                   "min": "min", "max": "max",
-                   "any_value": "any_value"}[a.kind]
-        finals.append(AggInput(combine, a.output, None, a.output))
+    decomposable = all(a.kind in COMBINABLE_KINDS for a in aggs)
+    if decomposable:
+        finals: List[AggInput] = [
+            AggInput(COMBINABLE_KINDS[a.kind], a.output, None, a.output)
+            for a in aggs]
 
     def f(cols, num_rows_vec):
         d = jax.lax.axis_index(AXIS)
         my_n = num_rows_vec[d]
         local = Batch(cols, my_n)
-        part = group_aggregate(local, list(key_names), list(aggs),
-                               groups_capacity=partial_cap)
-        moved, new_n = _shard_repartition(
-            part.columns, part.num_rows_device(), key_names, n, exch_cap)
-        fin = group_aggregate(Batch(moved, new_n), list(key_names),
-                              finals, groups_capacity=exch_cap)
+        if decomposable:
+            part = group_aggregate(local, list(key_names), list(aggs),
+                                   groups_capacity=partial_cap)
+            moved, new_n = _shard_repartition(
+                part.columns, part.num_rows_device(), key_names, n,
+                exch_cap)
+            fin = group_aggregate(Batch(moved, new_n), list(key_names),
+                                  finals, groups_capacity=exch_cap)
+        else:
+            # non-decomposable aggregates (count_distinct / percentile /
+            # argmin / argmax): repartition ROWS by key hash first, then
+            # aggregate exactly — every group is wholly on one shard
+            moved, new_n = _shard_repartition(
+                cols, my_n, key_names, n, exch_cap)
+            fin = group_aggregate(Batch(moved, new_n), list(key_names),
+                                  list(aggs), groups_capacity=exch_cap)
         counts = jax.lax.all_gather(fin.num_rows_device(), AXIS)
         return fin.columns, counts
 

@@ -560,13 +560,20 @@ class LogicalPlanner:
     def _rewrite_distinct_aggregation(self, node: AggregationNode):
         """SingleDistinctAggregationToGroupBy (iterative/rule/): when every
         distinct aggregate shares one argument and there are no masks,
-        dedupe via an inner group-by."""
-        distinct = {s: a for s, a in node.aggregates.items() if a.distinct}
+        dedupe via an inner group-by. count(DISTINCT x) needs no rewrite —
+        the executor lowers it to the exact count_distinct kernel
+        (ops/groupby.py), so it mixes freely with plain aggregates."""
+        distinct = {s: a for s, a in node.aggregates.items()
+                    if a.distinct}
         if not distinct:
+            return node
+        if all(a.kind == "count" for a in distinct.values()):
+            # every distinct aggregate is count(DISTINCT) -> executor
+            # handles them natively, mixing freely with plain aggs
             return node
         args = {a.argument for a in distinct.values()}
         plain = {s: a for s, a in node.aggregates.items()
-                 if not a.distinct}
+                 if s not in distinct}
         if len(args) != 1 or plain or any(
                 a.mask for a in distinct.values()):
             raise PlanningError(

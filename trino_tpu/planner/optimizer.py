@@ -35,10 +35,15 @@ from ..rex import Call, Const, InputRef, RowExpr, TRUE
 def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
     plan = push_filters(plan)
     if catalogs is not None:
-        from .stats import choose_join_sides
+        from .stats import choose_join_sides, reorder_joins
         force = "AUTOMATIC"
+        reorder = "AUTOMATIC"
         if session is not None:
             force = session.get("join_distribution_type") or "AUTOMATIC"
+            reorder = (session.get("join_reordering_strategy")
+                       or "AUTOMATIC")
+        if str(reorder).upper() != "NONE":
+            plan = reorder_joins(plan, catalogs)
         plan = choose_join_sides(plan, catalogs, force)
     plan = prune_columns(plan)
     plan = cleanup_projects(plan)

@@ -96,6 +96,104 @@ def _selectivity(e) -> float:
     return max(factor, 1e-4)
 
 
+def reorder_joins(node: PlanNode, catalogs: CatalogManager) -> PlanNode:
+    """Connectivity-first greedy join ordering over flattened inner-join
+    trees (reference: iterative/rule/EliminateCrossJoins.java +
+    ReorderJoins.java, reduced to one greedy pass): start from the
+    largest relation (the fact-table spine), repeatedly join the
+    smallest relation that an equi-edge connects to the joined set.
+    Eliminates the syntactic-order cross-join blowups of comma-join
+    star queries (TPC-DS q64 joins 18 relations; date_dim/demographics
+    arrive before the relations that connect them)."""
+    if isinstance(node, JoinNode) and node.join_type in ("inner",
+                                                         "cross"):
+        rels: list = []
+        edges: list = []
+        residuals: list = []
+        _flatten_inner(node, rels, edges, residuals, catalogs)
+        if len(rels) > 2:
+            return _greedy_join_tree(rels, edges, residuals, catalogs)
+        # fall through to generic recursion for 2-way joins
+    if not node.sources:
+        return node
+    import dataclasses
+    if dataclasses.is_dataclass(node):
+        updates = {}
+        for f in dataclasses.fields(node):
+            v = getattr(node, f.name)
+            if isinstance(v, PlanNode):
+                updates[f.name] = reorder_joins(v, catalogs)
+            elif isinstance(v, tuple) and v and all(
+                    isinstance(x, PlanNode) for x in v):
+                updates[f.name] = tuple(reorder_joins(x, catalogs)
+                                        for x in v)
+        if updates:
+            return dc_replace(node, **updates)
+    return node
+
+
+def _flatten_inner(n: PlanNode, rels, edges, residuals, catalogs):
+    if isinstance(n, JoinNode) and n.join_type in ("inner", "cross"):
+        _flatten_inner(n.left, rels, edges, residuals, catalogs)
+        _flatten_inner(n.right, rels, edges, residuals, catalogs)
+        edges.extend(n.criteria)
+        if n.filter is not None:
+            residuals.extend(rex.split_conjuncts(n.filter))
+    else:
+        rels.append(reorder_joins(n, catalogs))
+
+
+def _greedy_join_tree(rels, edges, residuals, catalogs) -> PlanNode:
+    schemas = [set(r.output_schema()) for r in rels]
+    sizes = [estimate_rows(r, catalogs) for r in rels]
+    sym_rel = {s: i for i, sc in enumerate(schemas) for s in sc}
+    n = len(rels)
+
+    start = max(range(n), key=lambda i: sizes[i])
+    joined = {start}
+    tree: PlanNode = rels[start]
+    avail = set(schemas[start])
+    rem_edges = list(edges)
+    rem_res = list(residuals)
+
+    while len(joined) < n:
+        cand = set()
+        for e in rem_edges:
+            il, ir = sym_rel[e.left], sym_rel[e.right]
+            if (il in joined) != (ir in joined):
+                cand.add(ir if il in joined else il)
+        if not cand:
+            cand = set(range(n)) - joined  # genuine cross join
+        nxt = min(cand, key=lambda i: sizes[i])
+
+        crit, keep_edges = [], []
+        for e in rem_edges:
+            il, ir = sym_rel[e.left], sym_rel[e.right]
+            if {il, ir} <= joined | {nxt} and nxt in {il, ir}:
+                crit.append(JoinClause(e.left, e.right) if il in joined
+                            else JoinClause(e.right, e.left))
+            else:
+                keep_edges.append(e)
+        rem_edges = keep_edges
+
+        new_avail = avail | schemas[nxt]
+        place, keep_res = [], []
+        for c in rem_res:
+            (place if rex.input_names(c) <= new_avail
+             else keep_res).append(c)
+        rem_res = keep_res
+
+        tree = JoinNode(tree, rels[nxt],
+                        "inner" if crit else "cross", tuple(crit),
+                        rex.and_all(place) if place else None)
+        joined.add(nxt)
+        avail = new_avail
+
+    if rem_res:
+        tree = FilterNode(tree, rex.and_all(rem_res))
+    return tree
+
+
 def choose_join_sides(node: PlanNode,
                       catalogs: CatalogManager,
                       force_dist: str = "AUTOMATIC") -> PlanNode:

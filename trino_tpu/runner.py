@@ -17,6 +17,7 @@ from .catalog import (CatalogManager, ColumnMetadata, TableHandle,
                       TableMetadata)
 from .columnar import Batch, batch_from_pylist
 from .connectors.memory import BlackholeConnector, MemoryConnector
+from .connectors.tpcds import TpcdsConnector
 from .connectors.tpch import TpchConnector
 from .exec import Executor, QueryError
 from .functions import list_functions
@@ -65,6 +66,7 @@ class LocalQueryRunner:
             self.catalogs = CatalogManager()
             if with_tpch:
                 self.catalogs.register("tpch", TpchConnector())
+                self.catalogs.register("tpcds", TpcdsConnector())
             self.catalogs.register("memory", MemoryConnector())
             self.catalogs.register("blackhole", BlackholeConnector())
         self.session = session or Session(catalog="tpch", schema="tiny")
