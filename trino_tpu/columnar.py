@@ -118,6 +118,13 @@ class Column:
     ``data`` rows beyond the owning Batch's num_rows are garbage.
     ``valid`` is None when every (live) row is non-null.
     ``data2`` is the high int64 lane for DECIMAL(p>18) Int128 emulation.
+
+    ARRAY columns (spi/block/ArrayBlock.java redesigned as
+    struct-of-arrays): ``data`` is the per-row START offset into the
+    flat ``elements`` column, ``data2`` the per-row LENGTH, and
+    ``elements`` holds every element value (its own Column, possibly
+    longer than the row capacity). Row gathers move only the
+    offset/length lanes; ``elements`` is shared untouched.
     """
 
     type: Type
@@ -125,6 +132,7 @@ class Column:
     valid: Optional[ArrayLike] = None
     dictionary: Optional[StringDictionary] = None
     data2: Optional[ArrayLike] = None
+    elements: Optional["Column"] = None
 
     def __post_init__(self):
         if is_string(self.type) and self.dictionary is None:
@@ -157,6 +165,7 @@ class Column:
         data2 = (None if self.data2 is None
                  else jnp.take(jnp.asarray(self.data2), indices, axis=0,
                                mode="clip"))
+        # elements are row-independent (offsets were gathered) — shared
         return replace(self, data=data, valid=valid, data2=data2)
 
     def valid_mask(self, n: Optional[int] = None) -> jax.Array:
@@ -328,6 +337,14 @@ class Batch:
                         # exact strings, FixJsonDataUtils.java)
                         col.append(q if not s
                                    else _dec.Decimal(q).scaleb(-s))
+            elif t.name.startswith("array("):
+                # materialize the flat elements once, slice per row
+                e = c.elements
+                ecap = int(np.asarray(e.data).shape[0])
+                epy = [r[0] for r in Batch({"e": e}, ecap).to_pylist()]
+                lens = np.asarray(c.data2)[:n]
+                col = [(epy[int(data[i]): int(data[i]) + int(lens[i])]
+                        if valid[i] else None) for i in range(n)]
             elif t.name == "boolean":
                 col = [bool(data[i]) if valid[i] else None for i in range(n)]
             elif t.name in ("real", "double"):
@@ -408,13 +425,13 @@ def empty_batch(schema: Dict[str, Type], capacity: int = 8) -> Batch:
 # compiled program embeds dictionary-derived lookup tables).
 
 def _column_flatten(c: Column):
-    return (c.data, c.valid, c.data2), (c.type, c.dictionary)
+    return (c.data, c.valid, c.data2, c.elements), (c.type, c.dictionary)
 
 
 def _column_unflatten(aux, children):
-    data, valid, data2 = children
+    data, valid, data2, elements = children
     typ, dictionary = aux
-    return Column(typ, data, valid, dictionary, data2)
+    return Column(typ, data, valid, dictionary, data2, elements)
 
 
 def _batch_flatten(b: Batch):

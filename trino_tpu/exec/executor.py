@@ -171,7 +171,7 @@ class Executor:
     _STREAM_CHAIN = None   # set after class body
 
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
-                      "approx_percentile"}
+                      "approx_percentile", "array_agg"}
 
     def _try_streaming_aggregation(self, node: AggregationNode):
         # kinds whose partials don't combine with a single-lane segment
@@ -532,6 +532,56 @@ class Executor:
                 BIGINT, jnp.full((src.capacity,), i, jnp.int64), None)
             copies.append(Batch(cols, src.num_rows))
         return device_concat(copies)
+
+    # ------------------------------------------------------------------
+    def _exec_UnnestNode(self, node) -> Batch:
+        """UNNEST: expand array rows into element rows (reference:
+        operator/unnest/UnnestOperator.java). The expansion is the same
+        searchsorted pattern as join output materialization — per-row
+        emit count = max array length, two-phase capacity."""
+        src = self.execute(node.source)
+        cap = src.capacity
+        live = src.row_valid()
+        arrs = {o: src.column(i) for o, i in node.unnest.items()}
+        lens = {}
+        for o, c in arrs.items():
+            ln = jnp.asarray(c.data2).astype(jnp.int64)
+            if c.valid is not None:
+                ln = jnp.where(jnp.asarray(c.valid), ln, 0)
+            lens[o] = ln
+        count = None
+        for ln in lens.values():
+            count = ln if count is None else jnp.maximum(count, ln)
+        count = jnp.where(live, count, 0)
+        total = int(jnp.sum(count))
+        out_cap = capacity_for(max(total, 1))
+        self._reserve(out_cap, len(node.replicate) + len(arrs) + 1,
+                      "unnest output")
+        incl = jnp.cumsum(count)
+        offs = incl - count
+        i = jnp.arange(out_cap, dtype=jnp.int64)
+        p = jnp.clip(jnp.searchsorted(incl, i, side="right"), 0, cap - 1)
+        j = i - jnp.take(offs, p)
+        cols: Dict[str, Column] = {}
+        for s in node.replicate:
+            cols[s] = src.column(s).gather(p)
+        for o, c in arrs.items():
+            el = c.elements
+            ecap = int(jnp.asarray(el.data).shape[0])
+            flat = jnp.take(jnp.asarray(c.data).astype(jnp.int64), p) + j
+            flat = jnp.clip(flat, 0, ecap - 1)
+            in_arr = j < jnp.take(lens[o], p)
+            data = jnp.take(jnp.asarray(el.data), flat)
+            valid = in_arr
+            if el.valid is not None:
+                valid = valid & jnp.take(jnp.asarray(el.valid), flat)
+            d2 = (None if el.data2 is None
+                  else jnp.take(jnp.asarray(el.data2), flat))
+            cols[o] = Column(el.type, data, valid, el.dictionary, d2,
+                             el.elements)
+        if node.ordinality:
+            cols[node.ordinality] = Column(BIGINT, j + 1, None)
+        return Batch(cols, total)
 
     # ------------------------------------------------------------------
     # joins
@@ -996,6 +1046,8 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
         elif kind == "approx_distinct":
             phys.append(AggInput("count_distinct", a.argument, a.mask,
                                  sym))
+        elif kind == "array_agg":
+            phys.append(AggInput("array_agg", a.argument, a.mask, sym))
         elif kind == "approx_percentile":
             phys.append(AggInput("percentile", a.argument, a.mask, sym,
                                  param=a.param))

@@ -1044,6 +1044,81 @@ def _float_pred(fn):
     return h
 
 
+# ---- arrays --------------------------------------------------------------
+# spi/block/ArrayBlock redesigned: per-row (start, length) lanes over a
+# flat elements Column (columnar.py Column.elements)
+
+def _array_ctor(e, batch):
+    from ..types import is_string as _isstr
+    items = [eval_expr(a, batch) for a in e.args]
+    k = len(items)
+    cap = batch.capacity
+    dic = None
+    if _isstr(items[0].type):
+        dic = items[0].dictionary
+        remaps = []
+        for it in items:
+            dic, _, ro = dic.merge(it.dictionary)
+            remaps.append(ro)
+        # earlier codes stay stable under later merges (merge appends)
+        lanes = [jnp.take(jnp.asarray(rm),
+                          jnp.asarray(it.data).astype(jnp.int32),
+                          mode="clip")
+                 for it, rm in zip(items, remaps)]
+    else:
+        lanes = [jnp.asarray(it.data) for it in items]
+    flat = jnp.stack(lanes, axis=1).reshape(-1)
+    valid_flat = None
+    if any(it.valid is not None for it in items):
+        vl = [jnp.ones((cap,), bool) if it.valid is None
+              else jnp.asarray(it.valid) for it in items]
+        valid_flat = jnp.stack(vl, axis=1).reshape(-1)
+    d2 = None
+    if any(it.data2 is not None for it in items):
+        l2 = [jnp.zeros((cap,), jnp.int64) if it.data2 is None
+              else jnp.asarray(it.data2) for it in items]
+        d2 = jnp.stack(l2, axis=1).reshape(-1)
+    elements = Column(e.type.element, flat, valid_flat, dic, d2)
+    start = jnp.arange(cap, dtype=jnp.int64) * k
+    length = jnp.full((cap,), k, jnp.int64)
+    return Column(e.type, start, None, None, length, elements)
+
+
+def _cardinality(e, batch):
+    a = eval_expr(e.args[0], batch)
+    if a.elements is None:
+        raise EvalError("cardinality requires an array")
+    return Column(BIGINT, jnp.asarray(a.data2).astype(jnp.int64),
+                  a.valid)
+
+
+def _element_at(e, batch):
+    a = eval_expr(e.args[0], batch)
+    i = eval_expr(e.args[1], batch)
+    if a.elements is None:
+        raise EvalError("element_at requires an array")
+    idx = jnp.asarray(i.data).astype(jnp.int64)
+    length = jnp.asarray(a.data2).astype(jnp.int64)
+    # 1-based; negative indexes from the end (reference element_at);
+    # out of range -> NULL
+    pos = jnp.where(idx < 0, length + idx, idx - 1)
+    inrange = (pos >= 0) & (pos < length)
+    flat_idx = jnp.asarray(a.data).astype(jnp.int64) + \
+        jnp.clip(pos, 0, jnp.maximum(length - 1, 0))
+    el = a.elements
+    edata = jnp.take(jnp.asarray(el.data), flat_idx, mode="clip")
+    valid = inrange
+    for v in (a.valid, i.valid):
+        if v is not None:
+            valid = valid & jnp.asarray(v)
+    if el.valid is not None:
+        valid = valid & jnp.take(jnp.asarray(el.valid), flat_idx,
+                                 mode="clip")
+    d2 = (None if el.data2 is None
+          else jnp.take(jnp.asarray(el.data2), flat_idx, mode="clip"))
+    return Column(el.type, edata, valid, el.dictionary, d2)
+
+
 # ---- dispatch table ------------------------------------------------------
 
 _DISPATCH: Dict[str, Callable] = {
@@ -1099,4 +1174,6 @@ _DISPATCH: Dict[str, Callable] = {
     "date_diff_days": _date_diff_days,
     "date_trunc": _date_trunc, "date_diff": _date_diff,
     "date_add": _date_add,
+    "$array": _array_ctor, "cardinality": _cardinality,
+    "element_at": _element_at,
 }

@@ -190,8 +190,18 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "to_hex": _varchar_fn,
     "from_hex": lambda n, a: VARCHAR,
     "xxhash64": _bigint_fn,
+    # arrays (operator/scalar/ArrayFunctions + ArraySubscript)
     "cardinality": _bigint_fn,
+    "element_at": lambda n, a: _array_elem(n, a),
 }
+
+
+def _array_elem(name, args):
+    from .types import ArrayType
+    if not args or not isinstance(args[0], ArrayType):
+        raise FunctionResolutionError(
+            f"{name} requires an array argument")
+    return args[0].element
 
 
 def _err(name, args):

@@ -443,6 +443,37 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         return _resorted_agg(batch, agg, col, gid, live_s, gcap,
                              key_lanes, extra_mask, order, live_u)
 
+    if agg.kind == "array_agg":
+        # group runs are contiguous in the sorted order: the flat
+        # elements column IS the group-sorted input; each group's array
+        # is (first position, included-row count). FILTER-masked rows
+        # are sunk to the end of their group run by a secondary sort
+        # lane so inclusion stays a prefix (reference:
+        # operator/aggregation/ArrayAggregationFunction — NULL inputs
+        # are collected, masked rows are not).
+        from ..types import ArrayType
+        from dataclasses import replace as _replace
+        cap = order.shape[0]
+        include = live_s if extra_mask is None else (live_s & extra_mask)
+        if extra_mask is not None:
+            live = (batch.row_valid() if live_u is None else live_u)
+            inc_u = jnp.zeros((cap,), bool).at[order].set(include)
+            use_order, use_gid, _, _, _ = _resort(
+                key_lanes, [(~inc_u).astype(jnp.uint64)], live, gcap)
+            use_inc = jnp.take(inc_u, use_order)
+        else:
+            use_order, use_gid, use_inc = order, gid, include
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        start = jax.ops.segment_min(
+            jnp.where(use_inc, pos, jnp.int64(cap)), use_gid,
+            num_segments=gcap)
+        length = jax.ops.segment_sum(use_inc.astype(jnp.int64), use_gid,
+                                     num_segments=gcap)
+        elements = col.gather(use_order)
+        return Column(ArrayType(col.type),
+                      jnp.clip(start, 0, cap - 1), length > 0, None,
+                      length, elements)
+
     raise ValueError(f"unknown aggregate kind {agg.kind}")
 
 
@@ -468,6 +499,26 @@ def _order_lane(col: Column, order=None) -> Tuple[jax.Array, object]:
     if order is not None:
         d = jnp.take(d, order)
     return d, decoder
+
+
+def _resort(key_lanes, tie_lanes, live, gcap: int):
+    """Re-sort rows by (key lanes, tie lanes) and recompute group ids.
+    Group ids stay aligned with the primary sort of group_aggregate
+    because both orders sort by the key lanes first. Returns
+    (order2, gid2, live_s2, key_changed, is_first)."""
+    cap = live.shape[0]
+    full = list(key_lanes) + list(tie_lanes)
+    order2 = jnp.lexsort(full[::-1])
+    live_s2 = jnp.take(live, order2)
+    changed = jnp.zeros((cap,), dtype=bool)
+    for lane in key_lanes[1:]:
+        s = jnp.take(lane, order2)
+        changed = changed | (s != jnp.roll(s, 1))
+    first = jnp.arange(cap) == 0
+    boundary2 = (changed | first) & live_s2
+    gid2 = jnp.clip(jnp.cumsum(boundary2.astype(jnp.int64)) - 1,
+                    0, gcap - 1).astype(jnp.int32)
+    return order2, gid2, live_s2, changed, first
 
 
 def _resorted_agg(batch: Batch, agg: AggInput, col: Column, gid, live_s,
@@ -504,17 +555,8 @@ def _resorted_agg(batch: Batch, agg: AggInput, col: Column, gid, live_s,
         olane, _ = _order_lane(col)
         tie = [(~valid_u).astype(jnp.uint64), olane]
 
-    full = list(key_lanes) + tie
-    order2 = jnp.lexsort(full[::-1])
-    live_s2 = jnp.take(live, order2)
-    changed_k = jnp.zeros((cap,), dtype=bool)
-    for lane in key_lanes[1:]:
-        s = jnp.take(lane, order2)
-        changed_k = changed_k | (s != jnp.roll(s, 1))
-    first = jnp.arange(cap) == 0
-    boundary2 = (changed_k | first) & live_s2
-    gid2 = jnp.clip(jnp.cumsum(boundary2.astype(jnp.int64)) - 1,
-                    0, gcap - 1).astype(jnp.int32)
+    order2, gid2, live_s2, changed_k, first = _resort(
+        key_lanes, tie, live, gcap)
     valid2 = jnp.take(valid_u, order2)
 
     if agg.kind == "count_distinct":
@@ -661,6 +703,16 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
                 changed = changed | (s != jnp.roll(s, 1))
             cnt = jnp.sum((changed & valid2).astype(jnp.int64))
             out[agg.output] = Column(BIGINT, cnt[None], None)
+        elif agg.kind == "array_agg":
+            from ..types import ArrayType
+            # included rows (live, FILTER-passing; NULL values stay)
+            inc = live if extra is None else live & extra
+            order2 = jnp.lexsort([(~inc).astype(jnp.uint64)][::-1])
+            elements = col.gather(order2)
+            n_inc = jnp.sum(inc.astype(jnp.int64))
+            out[agg.output] = Column(
+                ArrayType(col.type), jnp.zeros((1,), jnp.int64),
+                (n_inc > 0)[None], None, n_inc[None], elements)
         elif agg.kind == "percentile":
             from dataclasses import replace as _replace
             if col.data2 is not None:

@@ -161,8 +161,17 @@ def unshard_batch(sb: ShardedBatch) -> Batch:
                  else jnp.take(jnp.asarray(c.valid), gidx, mode="clip"))
         d2 = (None if c.data2 is None
               else jnp.take(jnp.asarray(c.data2), gidx, mode="clip"))
+        elements = c.elements
+        if elements is not None:
+            # array offsets are shard-local; after the gather the flat
+            # elements lanes of all shards are stacked, so each row's
+            # start shifts by its shard's slice of the elements array
+            ecap = int(jnp.asarray(elements.data).shape[0]) // max(n, 1)
+            shard_of_row = gidx // per
+            data = data + shard_of_row * ecap
         cols[name] = Column(c.type, jax.device_put(data),
                             None if valid is None else jax.device_put(
                                 valid), c.dictionary,
-                            None if d2 is None else jax.device_put(d2))
+                            None if d2 is None else jax.device_put(d2),
+                            elements)
     return Batch(cols, total)

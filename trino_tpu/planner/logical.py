@@ -33,8 +33,8 @@ from ..plan.nodes import (Aggregate, AggregationNode, AssignUniqueIdNode,
                           JoinNode, LimitNode, MarkDistinctNode, OffsetNode,
                           OutputNode, PlanNode, ProjectNode, SampleNode,
                           SemiJoinNode, SetOpNode, SortKey, SortNode,
-                          TableScanNode, TopNNode, UnionNode, ValuesNode,
-                          WindowFunction, WindowNode)
+                          TableScanNode, TopNNode, UnionNode, UnnestNode,
+                          ValuesNode, WindowFunction, WindowNode)
 from ..rex import Call, CaseExpr, Cast, Const, InputRef, RowExpr, TRUE
 from ..session import Session
 from ..sql import ast as A
@@ -681,6 +681,8 @@ class LogicalPlanner:
             return rp
         if isinstance(rel, A.ValuesRelation):
             return self._plan_values(rel.rows)
+        if isinstance(rel, A.Unnest):
+            return self._plan_unnest(rel, outer, None)
         if isinstance(rel, A.Join):
             return self._plan_join(rel, outer)
         if isinstance(rel, A.TableSample):
@@ -773,7 +775,72 @@ class LogicalPlanner:
                 "Schema must be specified when session schema is not set")
         return (self.session.catalog, self.session.schema, parts[0])
 
+    def _plan_unnest(self, rel: "A.Unnest", outer, lateral,
+                     alias: Optional[str] = None,
+                     colnames: Tuple[str, ...] = ()) -> RelationPlan:
+        """FROM UNNEST(arr) [WITH ORDINALITY], standalone or lateral
+        (CROSS JOIN UNNEST referencing earlier FROM items). Reference:
+        RelationPlanner.visitUnnest + operator/unnest/UnnestOperator."""
+        from ..types import ArrayType
+        if lateral is None:
+            one = self.symbols.new("unnest_src")
+            base_root: PlanNode = ValuesNode({one: BIGINT}, ((0,),))
+            base_scope = Scope([], outer)
+        else:
+            base_root, base_scope = lateral.root, lateral.scope
+        replicate = tuple(base_root.output_schema())
+        ctx = _ExprContext(self, base_scope, base_root)
+        pre: Dict[str, RowExpr] = {}
+        unnest_map: Dict[str, str] = {}
+        out_fields: List[Field] = []
+        i = 0
+        for ex in rel.exprs:
+            rx = ctx.rewrite(ex)
+            if not isinstance(rx.type, ArrayType):
+                raise PlanningError(
+                    f"UNNEST argument must be an array (got {rx.type})")
+            if isinstance(rx, InputRef):
+                sym = rx.name
+            else:
+                sym = self.symbols.new("unnest_arg")
+                pre[sym] = rx
+            osym = self.symbols.new("unnest")
+            unnest_map[osym] = sym
+            name = colnames[i].lower() if i < len(colnames) \
+                else f"col{i + 1}"
+            out_fields.append(Field(name, osym, rx.type.element, alias))
+            i += 1
+        ord_sym = None
+        if rel.with_ordinality:
+            ord_sym = self.symbols.new("ordinality")
+            name = colnames[i].lower() if i < len(colnames) \
+                else "ordinality"
+            out_fields.append(Field(name, ord_sym, BIGINT, alias))
+        root = base_root
+        if pre:
+            schema = root.output_schema()
+            full = {s: InputRef(s, t) for s, t in schema.items()}
+            full.update(pre)
+            root = ProjectNode(root, full)
+        node = UnnestNode(root, replicate, unnest_map, ord_sym)
+        base_fields = list(base_scope.fields) if lateral else []
+        return RelationPlan(node, Scope(base_fields + out_fields, outer))
+
     def _plan_join(self, rel: A.Join, outer) -> RelationPlan:
+        # lateral UNNEST: the right side references the left's columns
+        un = rel.right
+        un_alias, un_cols = None, ()
+        if isinstance(un, A.AliasedRelation) and \
+                isinstance(un.relation, A.Unnest):
+            un_alias = un.alias.lower()
+            un_cols = tuple(un.column_names)
+            un = un.relation
+        if isinstance(un, A.Unnest):
+            if rel.join_type != "cross" and rel.on is not None:
+                raise PlanningError(
+                    "JOIN UNNEST supports only CROSS JOIN")
+            left0 = self._plan_relation(rel.left, outer)
+            return self._plan_unnest(un, outer, left0, un_alias, un_cols)
         left = self._plan_relation(rel.left, outer)
         right = self._plan_relation(rel.right, outer)
         combined = Scope(left.scope.fields + right.scope.fields, outer)
@@ -1246,6 +1313,33 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
         return Call(e.field.lower(), (arg,), BIGINT)
     if isinstance(e, A.FunctionCall):
         return _plan_function(self, e, ctx)
+    if isinstance(e, A.ArrayConstructor):
+        from ..types import ArrayType
+        if not e.items:
+            raise PlanningError("empty ARRAY[] requires a cast")
+        items = [self._rewrite_expr(i, ctx) for i in e.items]
+        t = items[0].type
+        for it in items[1:]:
+            nt = common_super_type(t, it.type)
+            if nt is None:
+                raise PlanningError(
+                    f"ARRAY elements have incompatible types {t} / "
+                    f"{it.type}")
+            t = nt
+        items = [_maybe_cast(i, t) for i in items]
+        return Call("$array", tuple(items), ArrayType(t))
+    if isinstance(e, A.Subscript):
+        from ..types import ArrayType
+        base = self._rewrite_expr(e.base, ctx)
+        idx = self._rewrite_expr(e.index, ctx)
+        if not isinstance(base.type, ArrayType):
+            raise PlanningError(
+                f"subscript requires an array (got {base.type})")
+        # divergence from the reference: arr[i] out of range yields
+        # NULL (element_at semantics) instead of a runtime error —
+        # data-dependent raises can't surface from inside a compiled
+        # whole-column XLA program (SURVEY.md §7.2 static-shape rule)
+        return Call("element_at", (base, idx), base.type.element)
     if isinstance(e, A.Star):
         raise PlanningError("'*' not allowed here")
     raise PlanningError(f"unsupported expression {type(e).__name__}")

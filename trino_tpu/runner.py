@@ -101,6 +101,27 @@ class LocalQueryRunner:
         return result
 
     # ------------------------------------------------------------------
+    def execute_batch(self, sql: str):
+        """Run a query, returning the raw result Batch (the task-worker
+        data plane serializes it into page frames — server/
+        task_worker.py; the reference's TaskOutputOperator hands Pages
+        to the output buffer rather than JSON rows)."""
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, A.QueryStatement):
+            raise QueryError("execute_batch supports queries only")
+        planner = LogicalPlanner(self.catalogs, self.session)
+        plan = optimize(planner.plan(stmt), self.catalogs, self.session)
+        batch = self._make_executor(False).execute(plan)
+        # wire format carries DISPLAY column names, not plan symbols;
+        # repeated names are disambiguated positionally (the frame is
+        # keyed by name, unlike the reference's positional wire pages)
+        cols = {}
+        for i, (name, sym) in enumerate(zip(plan.names, plan.symbols)):
+            key = name if name not in cols else f"{name}${i}"
+            cols[key] = batch.column(sym)
+        return Batch(cols, batch.num_rows)
+
+    # ------------------------------------------------------------------
     def plan_sql(self, sql: str, optimized: bool = True) -> OutputNode:
         stmt = parse_statement(sql)
         if isinstance(stmt, A.Explain):

@@ -157,6 +157,39 @@ def _read_lane(buf: memoryview, off: int):
     return np.frombuffer(raw, dtype=_DTYPES[code]).copy(), off
 
 
+def _emit_column(out: list, name: str, col: Column, n: int, codec: int):
+    nb = name.encode()
+    tb = col.type.name.encode()
+    flags = ((1 if col.valid is not None else 0)
+             | (2 if col.data2 is not None else 0)
+             | (4 if col.dictionary is not None else 0)
+             | (8 if col.elements is not None else 0))
+    out.append(struct.pack("<H", len(nb)))
+    out.append(nb)
+    out.append(struct.pack("<H", len(tb)))
+    out.append(tb)
+    out.append(struct.pack("<B", flags))
+    out.append(struct.pack("<Q", n))
+    _emit_lane(out, np.asarray(col.data)[:n], codec)
+    if col.valid is not None:
+        _emit_lane(out, np.asarray(col.valid)[:n], codec)
+    if col.data2 is not None:
+        _emit_lane(out, np.asarray(col.data2)[:n], codec)
+    if col.dictionary is not None:
+        vals = col.dictionary.values
+        out.append(struct.pack("<I", len(vals)))
+        for v in vals:
+            vb = str(v).encode()
+            out.append(struct.pack("<I", len(vb)))
+            out.append(vb)
+    if col.elements is not None:
+        # arrays ship their whole flat elements column (offsets index
+        # into it; spi/block/ArrayBlock's values block analog)
+        el = col.elements
+        _emit_column(out, "$elements", el,
+                     int(np.asarray(el.data).shape[0]), codec)
+
+
 def serialize_batch(batch: Batch, codec: Optional[int] = None) -> bytes:
     """Batch -> framed bytes (live prefix only)."""
     if codec is None:
@@ -165,30 +198,51 @@ def serialize_batch(batch: Batch, codec: Optional[int] = None) -> bytes:
     out: list = [_MAGIC, struct.pack("<BIQ", codec,
                                      len(batch.columns), n)]
     for name, col in batch.columns.items():
-        nb = name.encode()
-        tb = col.type.name.encode()
-        flags = ((1 if col.valid is not None else 0)
-                 | (2 if col.data2 is not None else 0)
-                 | (4 if col.dictionary is not None else 0))
-        out.append(struct.pack("<H", len(nb)))
-        out.append(nb)
-        out.append(struct.pack("<H", len(tb)))
-        out.append(tb)
-        out.append(struct.pack("<B", flags))
-        _emit_lane(out, np.asarray(col.data)[:n], codec)
-        if col.valid is not None:
-            _emit_lane(out, np.asarray(col.valid)[:n], codec)
-        if col.data2 is not None:
-            _emit_lane(out, np.asarray(col.data2)[:n], codec)
-        if col.dictionary is not None:
-            vals = col.dictionary.values
-            out.append(struct.pack("<I", len(vals)))
-            for v in vals:
-                vb = str(v).encode()
-                out.append(struct.pack("<I", len(vb)))
-                out.append(vb)
+        _emit_column(out, name, col, n, codec)
     body = b"".join(out)
     return body + struct.pack("<Q", checksum(body))
+
+
+def _read_column(buf: memoryview, off: int):
+    (nlen,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    name = bytes(buf[off:off + nlen]).decode()
+    off += nlen
+    (tlen,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    typ = parse_type(bytes(buf[off:off + tlen]).decode())
+    off += tlen
+    (flags,) = struct.unpack_from("<B", buf, off)
+    off += 1
+    (n,) = struct.unpack_from("<Q", buf, off)
+    off += 8
+    data_arr, off = _read_lane(buf, off)
+    valid = d2 = dictionary = elements = None
+    if flags & 1:
+        valid, off = _read_lane(buf, off)
+    if flags & 2:
+        d2, off = _read_lane(buf, off)
+    if flags & 4:
+        (cnt,) = struct.unpack_from("<I", buf, off)
+        off += 4
+        vals = []
+        for _ in range(cnt):
+            (vlen,) = struct.unpack_from("<I", buf, off)
+            off += 4
+            vals.append(bytes(buf[off:off + vlen]).decode())
+            off += vlen
+        dictionary = StringDictionary(np.asarray(vals, dtype=object))
+    if flags & 8:
+        _, elements, off = _read_column(buf, off)
+    cap = capacity_for(max(int(n), 1), minimum=8)
+    pad = cap - len(data_arr)
+    data_arr = np.pad(data_arr, (0, pad))
+    if valid is not None:
+        valid = np.pad(valid, (0, pad))
+    if d2 is not None:
+        d2 = np.pad(d2, (0, pad))
+    return name, Column(typ, data_arr, valid, dictionary, d2,
+                        elements), off
 
 
 def deserialize_batch(data: bytes) -> Batch:
@@ -201,41 +255,22 @@ def deserialize_batch(data: bytes) -> Batch:
     codec, ncols, nrows = struct.unpack_from("<BIQ", buf, 4)
     off = 4 + struct.calcsize("<BIQ")
     cols: Dict[str, Column] = {}
-    cap = capacity_for(max(int(nrows), 1), minimum=8)
     for _ in range(ncols):
-        (nlen,) = struct.unpack_from("<H", buf, off)
-        off += 2
-        name = bytes(buf[off:off + nlen]).decode()
-        off += nlen
-        (tlen,) = struct.unpack_from("<H", buf, off)
-        off += 2
-        typ = parse_type(bytes(buf[off:off + tlen]).decode())
-        off += tlen
-        (flags,) = struct.unpack_from("<B", buf, off)
-        off += 1
-        data_arr, off = _read_lane(buf, off)
-        valid = d2 = dictionary = None
-        if flags & 1:
-            valid, off = _read_lane(buf, off)
-        if flags & 2:
-            d2, off = _read_lane(buf, off)
-        if flags & 4:
-            (cnt,) = struct.unpack_from("<I", buf, off)
-            off += 4
-            vals = []
-            for _ in range(cnt):
-                (vlen,) = struct.unpack_from("<I", buf, off)
-                off += 4
-                vals.append(bytes(buf[off:off + vlen]).decode())
-                off += vlen
-            dictionary = StringDictionary(np.asarray(vals, dtype=object))
-        pad = cap - len(data_arr)
-        data_arr = np.pad(data_arr, (0, pad))
-        if valid is not None:
-            valid = np.pad(valid, (0, pad))
-        if d2 is not None:
-            d2 = np.pad(d2, (0, pad))
-        cols[name] = Column(typ, data_arr, valid, dictionary, d2)
+        name, col, off = _read_column(buf, off)
+        # top-level columns pad to the BATCH's capacity bucket
+        cap = capacity_for(max(int(nrows), 1), minimum=8)
+        k = len(np.asarray(col.data))
+        if k < cap:
+            from dataclasses import replace as _replace
+            col = _replace(
+                col, data=np.pad(np.asarray(col.data), (0, cap - k)),
+                valid=(None if col.valid is None
+                       else np.pad(np.asarray(col.valid),
+                                   (0, cap - k))),
+                data2=(None if col.data2 is None
+                       else np.pad(np.asarray(col.data2),
+                                   (0, cap - k))))
+        cols[name] = col
     return Batch(cols, int(nrows))
 
 

@@ -1,0 +1,273 @@
+"""Worker task runtime + host data plane (the DCN leg).
+
+Reference parity: the coordinator->worker task stack and the pull-based
+page exchange —
+  server/TaskResource.java:84-127 (POST /v1/task/{id}),
+  TaskResource.java:261-266 (GET /v1/task/{id}/results/{bufferId}/{token}
+  with token acknowledgement :321-325),
+  execution/SqlTaskManager.java:370-403, operator/ExchangeClient.java:149.
+
+TPU-first split (SURVEY.md §7.4): *within* a slice the exchange is an
+XLA collective (parallel/spmd.py); *across hosts* pages move as
+serialized column frames (serde.py: struct-of-arrays + LZ4 + xxh64) over
+HTTP with the reference's pull/ack model. This module is that
+cross-host leg: a worker process executes a task (SQL fragment) and
+buffers its result as page frames; clients pull frames token by token.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..columnar import Batch, Column
+from ..serde import deserialize_batch, serialize_batch
+
+PAGE_ROWS = 1 << 16
+
+
+def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
+    cols = {}
+    for s, c in b.columns.items():
+        data = np.asarray(c.data)[lo:hi]
+        valid = None if c.valid is None else np.asarray(c.valid)[lo:hi]
+        d2 = None if c.data2 is None else np.asarray(c.data2)[lo:hi]
+        # elements ride whole: sliced offsets still index into them
+        cols[s] = Column(c.type, data, valid, c.dictionary, d2,
+                         c.elements)
+    return Batch(cols, hi - lo)
+
+
+def paginate(b: Batch, page_rows: int = PAGE_ROWS) -> List[bytes]:
+    """Serialize a result batch as page frames (PagesSerde.serialize)."""
+    n = b.num_rows_host()
+    if n == 0:
+        return [serialize_batch(_slice_batch(b, 0, 0))]
+    return [serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)))
+            for lo in range(0, n, page_rows)]
+
+
+class _Task:
+    """One task's lifecycle + output buffer (execution/SqlTask.java +
+    the ClientBuffer token protocol)."""
+
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self.state = "RUNNING"
+        self.error: Optional[str] = None
+        self.pages: List[bytes] = []
+        self.done = threading.Event()
+
+    def run(self, payload: dict):
+        try:
+            from ..runner import LocalQueryRunner
+            from ..session import Session
+            session = Session(catalog=payload.get("catalog"),
+                              schema=payload.get("schema"))
+            for name, value in payload.get("properties", {}).items():
+                session.set(name, value)
+            runner = LocalQueryRunner(session=session)
+            res = runner.execute_batch(payload["sql"])
+            self.pages = paginate(res)
+            self.state = "FINISHED"
+        except Exception as e:   # noqa: BLE001
+            self.state = "FAILED"
+            self.error = f"{type(e).__name__}: {e}"
+        finally:
+            self.done.set()
+
+
+class TaskWorkerServer:
+    """A worker node: accepts tasks, executes them, serves result pages.
+    One process per worker (the reference's worker JVM)."""
+
+    def __init__(self, port: int = 0):
+        self._tasks: Dict[str, _Task] = {}
+        self._lock = threading.Lock()
+        worker = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_POST(self):
+                parts = self.path.strip("/").split("/")
+                # /v1/task/{id}
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    t = worker.create_task(parts[2], payload)
+                    body = json.dumps(
+                        {"taskId": t.task_id, "state": t.state}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_error(404)
+
+            def do_GET(self):
+                parts = self.path.strip("/").split("/")
+                # /v1/task/{id}/results/{token}
+                if len(parts) == 5 and parts[3] == "results":
+                    tid, token = parts[2], int(parts[4])
+                    t = worker.get_task(tid)
+                    if t is None:
+                        self.send_error(404)
+                        return
+                    t.done.wait(timeout=300)
+                    if t.state != "FINISHED":
+                        # still RUNNING (wait timed out), FAILED, or
+                        # CANCELED — never report an empty complete
+                        # result for a task that didn't finish
+                        body = (t.error
+                                or f"task is {t.state}").encode()
+                        self.send_response(500)
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                    complete = token >= len(t.pages)
+                    body = b"" if complete else t.pages[token]
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("X-TT-Complete",
+                                     "true" if complete else "false")
+                    self.send_header("X-TT-Next-Token", str(token + 1))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                # /v1/task/{id} -> status
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    t = worker.get_task(parts[2])
+                    if t is None:
+                        self.send_error(404)
+                        return
+                    body = json.dumps({"taskId": t.task_id,
+                                       "state": t.state,
+                                       "error": t.error}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_error(404)
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    worker.abort_task(parts[2])
+                    self.send_response(204)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_error(404)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_address[1]
+        self.base_uri = f"http://127.0.0.1:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    # -- task manager (SqlTaskManager) --------------------------------
+    def create_task(self, tid: str, payload: dict) -> _Task:
+        with self._lock:
+            t = self._tasks.get(tid)
+            if t is not None:
+                return t          # idempotent update (TaskResource)
+            t = _Task(tid)
+            self._tasks[tid] = t
+        threading.Thread(target=t.run, args=(payload,),
+                         daemon=True).start()
+        return t
+
+    def get_task(self, tid: str) -> Optional[_Task]:
+        with self._lock:
+            return self._tasks.get(tid)
+
+    def abort_task(self, tid: str):
+        with self._lock:
+            t = self._tasks.pop(tid, None)
+        if t is not None:
+            t.state = "CANCELED"
+            t.done.set()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def worker_main(conn, platform: Optional[str] = None):
+    """Entry point for a worker child process: binds an ephemeral port,
+    reports it through the pipe, serves until killed.
+
+    ``platform`` pins the JAX backend BEFORE anything imports jax — on
+    a TPU-attached host a child must not contend for the (exclusive)
+    chip the parent holds; test harnesses pass "cpu"."""
+    import os
+    if platform:
+        os.environ["JAX_PLATFORMS"] = platform
+        os.environ.pop("PYTHONPATH", None)  # skip axon sitecustomize
+        import jax
+        jax.config.update("jax_platforms", platform)
+    srv = TaskWorkerServer().start()
+    conn.send(srv.port)
+    conn.close()
+    srv._thread.join()
+
+
+class RemoteTaskClient:
+    """Coordinator-side proxy for one remote task (HttpRemoteTask +
+    ExchangeClient/HttpPageBufferClient pull loop, collapsed)."""
+
+    def __init__(self, base_uri: str):
+        self.base_uri = base_uri.rstrip("/")
+
+    def submit(self, task_id: str, sql: str, catalog: str = "tpch",
+               schema: str = "tiny", properties: Optional[dict] = None):
+        payload = json.dumps({"sql": sql, "catalog": catalog,
+                              "schema": schema,
+                              "properties": properties or {}}).encode()
+        req = urllib.request.Request(
+            f"{self.base_uri}/v1/task/{task_id}", data=payload,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return json.loads(r.read())
+
+    def pages(self, task_id: str) -> List[Batch]:
+        """Pull every result page (token-acknowledged long-poll)."""
+        out: List[Batch] = []
+        token = 0
+        while True:
+            with urllib.request.urlopen(
+                    f"{self.base_uri}/v1/task/{task_id}/results/{token}",
+                    timeout=600) as r:
+                complete = r.headers.get("X-TT-Complete") == "true"
+                body = r.read()
+            if complete:
+                break
+            out.append(deserialize_batch(body))
+            token += 1
+        return out
+
+    def abort(self, task_id: str):
+        req = urllib.request.Request(
+            f"{self.base_uri}/v1/task/{task_id}", method="DELETE")
+        with urllib.request.urlopen(req, timeout=30):
+            pass

@@ -263,8 +263,12 @@ _SIMPLE["timestamp"] = TimestampType(3)
 
 
 def parse_type(s: str) -> Type:
-    """Parse a SQL type name, e.g. 'decimal(12,2)' (reference:
+    """Parse a SQL type name, e.g. 'decimal(12,2)' or
+    'array(varchar(25))' (reference:
     core/trino-main/.../type/TypeRegistry.java)."""
+    low = s.strip().lower()
+    if low.startswith("array(") and low.endswith(")"):
+        return ArrayType(parse_type(low[len("array("):-1]))
     m = _TYPE_RE.match(s.lower())
     if not m:
         raise ValueError(f"cannot parse type: {s!r}")
