@@ -1,0 +1,60 @@
+"""Dynamic filtering tests (reference: server/DynamicFilterService.java
++ operator/DynamicFilterSourceOperator.java): build-side key domains
+prune probe rows before the exchange in distributed inner joins."""
+
+import pytest
+
+from trino_tpu.exec.distributed import DistributedExecutor
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+
+SQL = ("SELECT count(*), sum(l_extendedprice) FROM tpch.tiny.lineitem "
+       "JOIN (SELECT o_orderkey FROM tpch.tiny.orders "
+       "      WHERE o_totalprice > 400000) t "
+       "ON l_orderkey = o_orderkey")
+
+
+@pytest.fixture(scope="module")
+def runners():
+    return (LocalQueryRunner(),
+            LocalQueryRunner(distributed=True, n_devices=8))
+
+
+def test_dynamic_filter_correct_and_effective(runners):
+    local, dist = runners
+    assert dist.execute(SQL).rows == local.execute(SQL).rows
+    ex = DistributedExecutor(dist.catalogs,
+                             Session(catalog="tpch", schema="tiny"),
+                             collect_stats=True)
+    ex.execute(dist.plan_sql(SQL))
+    before, after = ex.dynamic_filter_rows
+    # exchange input drops by >99% on this shape (~22 hot orders)
+    assert after < before * 0.01
+
+
+def test_dynamic_filter_flag_disables(runners):
+    _, dist = runners
+    s = Session(catalog="tpch", schema="tiny")
+    s.set("enable_dynamic_filtering", "false")
+    ex = DistributedExecutor(dist.catalogs, s)
+    ex.execute(dist.plan_sql(SQL))
+    assert not hasattr(ex, "dynamic_filter_rows")
+
+
+def test_dynamic_filter_left_join_untouched(runners):
+    local, dist = runners
+    sql = ("SELECT count(*), count(t.o_orderkey) FROM "
+           "tpch.tiny.lineitem LEFT JOIN "
+           "(SELECT o_orderkey FROM tpch.tiny.orders "
+           " WHERE o_totalprice > 400000) t "
+           "ON l_orderkey = t.o_orderkey")
+    assert dist.execute(sql).rows == local.execute(sql).rows
+
+
+def test_dynamic_filter_empty_build(runners):
+    local, dist = runners
+    sql = ("SELECT count(*) FROM tpch.tiny.lineitem JOIN "
+           "(SELECT o_orderkey FROM tpch.tiny.orders "
+           " WHERE o_totalprice > 99999999) t "
+           "ON l_orderkey = t.o_orderkey")
+    assert dist.execute(sql).rows == local.execute(sql).rows == [[0]]

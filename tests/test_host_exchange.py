@@ -140,8 +140,7 @@ def test_two_process_partial_final_aggregation():
         # final combine in the parent engine
         from trino_tpu.exec.executor import device_concat
         from trino_tpu.ops.groupby import AggInput, group_aggregate
-        merged = device_concat([b for b in batches
-                                if b.num_rows_host() >= 0])
+        merged = device_concat(batches)
         fin = group_aggregate(
             merged, ["pri"],
             [AggInput("sum", "c", output="c"),

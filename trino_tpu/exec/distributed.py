@@ -286,6 +286,14 @@ class DistributedExecutor(Executor):
         node = dc_replace(node, filter=join_verify_filter(
             left.columns, right.columns, pkeys, bkeys, node.filter))
 
+        # dynamic filtering: build-side key ranges prune probe rows
+        # BEFORE any exchange (reference: DynamicFilterService.java:95 +
+        # DynamicFilterSourceOperator — collect on the build, push to
+        # the probe; here collection is a host reduction over the build
+        # key lanes and the push is a per-shard pre-filter)
+        probe = self._dynamic_filter_probe(probe, right, pkeys, bkeys,
+                                           jt)
+
         # PARTITIONED distribution (DetermineJoinDistributionType's
         # PARTITIONED branch): hash-repartition BOTH sides on the join
         # keys so matching rows co-locate, then per-shard join — the
@@ -320,6 +328,87 @@ class DistributedExecutor(Executor):
                                out_cap, pad_cap)
 
         return shard_apply2(probe, build_host, phase2, out_cap + pad_cap)
+
+    def _dynamic_filter_probe(self, probe: ShardedBatch, build: Value,
+                              pkeys, bkeys, jt: str) -> ShardedBatch:
+        """Pre-exchange probe pruning from build-side key min/max
+        (enable_dynamic_filtering session property). INNER joins only —
+        outer probe rows must survive. Dictionary keys are skipped
+        (codes are shard-local). Records rows_in/rows_kept on the
+        executor for EXPLAIN/verification."""
+        if jt != "inner" or not isinstance(probe, ShardedBatch):
+            return probe
+        if not bool(self.session.get("enable_dynamic_filtering")):
+            return probe
+        bounds = []
+        for pk, bk in zip(pkeys, bkeys):
+            pc = probe.columns[pk]
+            bc = build.columns[bk]
+            if pc.dictionary is not None or bc.dictionary is not None \
+                    or bc.data2 is not None:
+                continue
+            data = np.asarray(bc.data)
+            if isinstance(build, ShardedBatch):
+                per = build.per_shard_cap
+                counts = np.asarray(build.num_rows)
+                live = (np.arange(per)[None, :]
+                        < counts[:, None]).reshape(-1)
+            else:
+                n = build.num_rows_host()
+                live = np.arange(data.shape[0]) < n
+            if bc.valid is not None:
+                live = live & np.asarray(bc.valid)
+            vals = data[live]
+            if vals.size == 0:
+                bounds.append((pk, 1, 0, None, False))  # drop all
+            else:
+                # small-domain exact set beats min/max by orders of
+                # magnitude on sparse keys (the reference's
+                # discrete-values DynamicFilter domain)
+                uniq = np.unique(vals)
+                exact = (jnp.asarray(uniq)
+                         if uniq.size <= 100_000 and
+                         uniq.dtype.kind in "iu" else None)
+                has_nan = (vals.dtype.kind == "f"
+                           and bool(np.isnan(vals).any()))
+                with np.errstate(invalid="ignore"):
+                    mn = (np.nanmin(vals) if has_nan else vals.min())
+                    mx = (np.nanmax(vals) if has_nan else vals.max())
+                bounds.append((pk, mn, mx, exact, has_nan))
+        if not bounds:
+            return probe
+
+        def f(b: Batch) -> Batch:
+            mask = b.row_valid()
+            for pk, mn, mx, exact, has_nan in bounds:
+                c = b.column(pk)
+                d = jnp.asarray(c.data)
+                if exact is not None:
+                    pos = jnp.searchsorted(exact, d)
+                    hit = jnp.take(exact, jnp.clip(pos, 0,
+                                                   exact.shape[0] - 1),
+                                   mode="clip") == d
+                    m = hit & (pos < exact.shape[0])
+                else:
+                    m = (d >= mn) & (d <= mx)
+                    if has_nan:
+                        # engine equality treats all NaNs as equal
+                        # (ops/hashing.py), so NaN probes can match a
+                        # NaN build key and must survive the filter
+                        m = m | jnp.isnan(d)
+                if c.valid is not None:
+                    # NULL keys never match an inner join
+                    m = m & jnp.asarray(c.valid)
+                mask = mask & m
+            return compact.filter_batch(b, mask)
+
+        if self.collect_stats:
+            before = probe.total_rows_host()
+            kept = shard_apply(probe, f, probe.per_shard_cap)
+            self.dynamic_filter_rows = (before,
+                                        kept.total_rows_host())
+            return kept
+        return shard_apply(probe, f, probe.per_shard_cap)
 
     def _partitioned_join(self, node: JoinNode, probe: ShardedBatch,
                           build: ShardedBatch, pkeys, bkeys,

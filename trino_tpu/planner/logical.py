@@ -1335,10 +1335,16 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
         if not isinstance(base.type, ArrayType):
             raise PlanningError(
                 f"subscript requires an array (got {base.type})")
-        # divergence from the reference: arr[i] out of range yields
-        # NULL (element_at semantics) instead of a runtime error —
-        # data-dependent raises can't surface from inside a compiled
+        # constant non-positive subscripts error at plan time (the
+        # reference's runtime errors, hoisted); data-dependent indexes
+        # diverge: out of range yields NULL (element_at semantics)
+        # because raises can't surface from inside a compiled
         # whole-column XLA program (SURVEY.md §7.2 static-shape rule)
+        if isinstance(idx, Const) and idx.value is not None \
+                and int(idx.value) <= 0:
+            raise PlanningError(
+                "Array subscript must be positive: SQL array indices "
+                "start at 1")
         return Call("element_at", (base, idx), base.type.element)
     if isinstance(e, A.Star):
         raise PlanningError("'*' not allowed here")

@@ -256,20 +256,10 @@ def deserialize_batch(data: bytes) -> Batch:
     off = 4 + struct.calcsize("<BIQ")
     cols: Dict[str, Column] = {}
     for _ in range(ncols):
+        # _read_column pads each column to capacity_for(its n); every
+        # top-level column carries the batch's n, so they share the
+        # batch's capacity bucket
         name, col, off = _read_column(buf, off)
-        # top-level columns pad to the BATCH's capacity bucket
-        cap = capacity_for(max(int(nrows), 1), minimum=8)
-        k = len(np.asarray(col.data))
-        if k < cap:
-            from dataclasses import replace as _replace
-            col = _replace(
-                col, data=np.pad(np.asarray(col.data), (0, cap - k)),
-                valid=(None if col.valid is None
-                       else np.pad(np.asarray(col.valid),
-                                   (0, cap - k))),
-                data2=(None if col.data2 is None
-                       else np.pad(np.asarray(col.data2),
-                                   (0, cap - k))))
         cols[name] = col
     return Batch(cols, int(nrows))
 

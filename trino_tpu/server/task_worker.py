@@ -44,10 +44,15 @@ def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
 
 
 def paginate(b: Batch, page_rows: int = PAGE_ROWS) -> List[bytes]:
-    """Serialize a result batch as page frames (PagesSerde.serialize)."""
+    """Serialize a result batch as page frames (PagesSerde.serialize).
+    Array results ship as a single frame: offsets reference the shared
+    flat elements column, so slicing rows would re-ship the whole
+    elements buffer once per page."""
     n = b.num_rows_host()
     if n == 0:
         return [serialize_batch(_slice_batch(b, 0, 0))]
+    if any(c.elements is not None for c in b.columns.values()):
+        return [serialize_batch(_slice_batch(b, 0, n))]
     return [serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)))
             for lo in range(0, n, page_rows)]
 
