@@ -1,0 +1,99 @@
+"""TIME type, unix-time / MySQL-format datetime functions, JSON
+functions, nth_value.
+
+Reference parity: spi/type/TimeType.java,
+operator/scalar/DateTimeFunctions.java (from_unixtime/to_unixtime/
+date_format/date_parse), operator/scalar/JsonFunctions.java,
+operator/window/NthValueFunction.java.
+"""
+
+import datetime
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_time_literal_and_fields(runner):
+    got = q(runner, "SELECT TIME '10:30:45', hour(TIME '10:30:45'), "
+                    "minute(TIME '10:30:45'), second(TIME '10:30:45')")
+    assert got == [[datetime.time(10, 30, 45), 10, 30, 45]]
+
+
+def test_time_compare_cast_minmax(runner):
+    got = q(runner, "SELECT TIME '11:00:00' > TIME '10:30:00', "
+                    "CAST('09:08:07' AS time)")
+    assert got == [[True, datetime.time(9, 8, 7)]]
+    got = q(runner, "SELECT min(t), max(t) FROM (VALUES TIME '10:00:00',"
+                    " TIME '09:00:00', NULL) x(t)")
+    assert got == [[datetime.time(9), datetime.time(10)]]
+
+
+def test_unixtime_roundtrip(runner):
+    got = q(runner, "SELECT to_unixtime(from_unixtime(12345))")
+    assert got == [[12345.0]]
+    got = q(runner, "SELECT from_unixtime(86400)")
+    assert got == [[datetime.datetime(1970, 1, 2)]]
+
+
+def test_date_format_parse(runner):
+    got = q(runner, "SELECT date_format(TIMESTAMP '2020-03-01 10:30:00',"
+                    " '%Y-%m-%d %H:%i'), "
+                    "date_format(DATE '2021-06-15', '%W'), "
+                    "date_parse('2020-03-01 10:30', '%Y-%m-%d %H:%i')")
+    assert got == [['2020-03-01 10:30', 'Tuesday',
+                    datetime.datetime(2020, 3, 1, 10, 30)]]
+
+
+def test_date_parse_bad_input_null(runner):
+    got = q(runner, "SELECT date_parse(x, '%Y-%m-%d') FROM "
+                    "(VALUES 'nope', '2020-01-02') t(x) ORDER BY 1")
+    # NULLS LAST is the engine default for ASC (Trino semantics)
+    assert got == [[datetime.datetime(2020, 1, 2)], [None]]
+
+
+def test_json_extract_scalar(runner):
+    got = q(runner, """SELECT json_extract_scalar(j, '$.name'),
+        json_extract_scalar(j, '$.tags[1]'),
+        json_extract_scalar(j, '$.missing'),
+        json_array_length(json_extract(j, '$.tags')),
+        json_size(j, '$')
+        FROM (VALUES '{"name": "ab", "tags": ["x", "y"], "n": 3}') t(j)
+    """)
+    assert got == [['ab', 'y', None, 2, 3]]
+
+
+def test_json_invalid_and_types(runner):
+    got = q(runner, "SELECT json_extract_scalar('not json', '$.a'), "
+                    "json_extract_scalar('[1,2,3]', '$[2]'), "
+                    "json_extract_scalar('{\"b\": true}', '$.b')")
+    assert got == [[None, '3', 'true']]
+
+
+def test_nth_value(runner):
+    got = q(runner, "SELECT x, nth_value(x, 2) OVER "
+                    "(ORDER BY x ROWS BETWEEN UNBOUNDED PRECEDING AND "
+                    "UNBOUNDED FOLLOWING) FROM (VALUES 10, 20, 30) t(x)")
+    assert got == [[10, 20], [20, 20], [30, 20]]
+    # running frame: nth row not yet visible -> NULL
+    got = q(runner, "SELECT x, nth_value(x, 3) OVER (ORDER BY x) "
+                    "FROM (VALUES 1, 2, 3) t(x) ORDER BY x")
+    assert got == [[1, None], [2, None], [3, 3]]
+
+
+def test_nth_value_partitioned(runner):
+    got = q(runner, "SELECT DISTINCT n_regionkey, nth_value(n_name, 2) "
+                    "OVER (PARTITION BY n_regionkey ORDER BY n_nationkey"
+                    " ROWS BETWEEN UNBOUNDED PRECEDING AND UNBOUNDED "
+                    "FOLLOWING) FROM tpch.tiny.nation ORDER BY 1")
+    assert len(got) == 5
+    assert got[0][1] == 'ETHIOPIA'

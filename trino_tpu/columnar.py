@@ -360,6 +360,18 @@ class Batch:
                 col = [(_dt.datetime(1970, 1, 1)
                         + _dt.timedelta(milliseconds=int(data[i])))
                        if valid[i] else None for i in range(n)]
+            elif t.name.startswith("time("):
+                import datetime as _dt
+                col = []
+                for i in range(n):
+                    if not valid[i]:
+                        col.append(None)
+                        continue
+                    ms = int(data[i]) % 86400000
+                    col.append(_dt.time(ms // 3600000,
+                                        (ms // 60000) % 60,
+                                        (ms // 1000) % 60,
+                                        (ms % 1000) * 1000))
             else:
                 col = [int(data[i]) if valid[i] else None for i in range(n)]
             out_cols.append(col)

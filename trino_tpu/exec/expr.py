@@ -120,7 +120,12 @@ def _dict_transform(col: Column, fn: Callable[[str], object],
         d, codes = StringDictionary.from_strings(out)
         table = jnp.asarray(codes.astype(np.int32))
         data = jnp.take(table, _lane(col), mode="clip")
-        return Column(out_type, data, col.valid, d)
+        valid = col.valid
+        nulls = np.asarray([v is None for v in out], dtype=bool)
+        if nulls.any():
+            nv = ~jnp.take(jnp.asarray(nulls), _lane(col), mode="clip")
+            valid = nv if valid is None else (jnp.asarray(valid) & nv)
+        return Column(out_type, data, valid, d)
     # numeric/boolean result: value table gather
     nulls = np.asarray([v is None for v in out], dtype=bool)
     dt = out_type.np_dtype
@@ -303,6 +308,13 @@ def _parser_for(t: Type, safe: bool):
                 from decimal import Decimal
                 q = Decimal(v.strip()).scaleb(t.scale)
                 return int(q.to_integral_value())
+            if isinstance(t, TimestampType):
+                from ..types import iso_timestamp_millis
+                return iso_timestamp_millis(v)
+            from ..types import TimeType as _TT
+            if isinstance(t, _TT):
+                from ..types import iso_time_millis
+                return iso_time_millis(v)
         except (ValueError, ArithmeticError):
             if safe:
                 return None
@@ -888,8 +900,9 @@ def _extract(field: str):
 
 def _time_field(field: str):
     def h(e, batch):
+        from ..types import TimeType
         a = eval_expr(e.args[0], batch)
-        if not isinstance(a.type, TimestampType):
+        if not isinstance(a.type, (TimestampType, TimeType)):
             return Column(BIGINT, jnp.zeros((batch.capacity,), jnp.int64),
                           a.valid)
         ms = jnp.mod(_lane(a), 86400000)
@@ -1044,6 +1057,163 @@ def _float_pred(fn):
     return h
 
 
+# ---- unix time + MySQL-style datetime formatting -------------------------
+# (operator/scalar/DateTimeFunctions.java: from_unixtime, to_unixtime,
+# date_format, date_parse — format codes are the MySQL set)
+
+_MYSQL_FMT = {"Y": "%Y", "y": "%y", "m": "%m", "c": "%m", "d": "%d",
+              "e": "%d", "H": "%H", "k": "%H", "h": "%I", "I": "%I",
+              "i": "%M", "s": "%S", "S": "%S", "f": "%f", "p": "%p",
+              "W": "%A", "a": "%a", "b": "%b", "M": "%B", "j": "%j",
+              "T": "%H:%M:%S", "%": "%%"}
+
+
+def _mysql_to_py_format(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        ch = fmt[i]
+        if ch == "%" and i + 1 < len(fmt):
+            code = fmt[i + 1]
+            if code not in _MYSQL_FMT:
+                # fail loudly rather than emit plausible wrong output
+                raise EvalError(
+                    f"unsupported datetime format code '%{code}'")
+            out.append(_MYSQL_FMT[code])
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+def _const_str(e) -> str:
+    from ..rex import Const as _Const
+    if not isinstance(e, _Const) or e.value is None:
+        raise EvalError("format string must be a constant")
+    return str(e.value)
+
+
+def _from_unixtime(e, batch):
+    a = eval_expr(e.args[0], batch)
+    ms = jnp.round(_lane(a).astype(jnp.float64) * 1000.0) \
+        .astype(jnp.int64)
+    return Column(e.type, ms, a.valid)
+
+
+def _to_unixtime(e, batch):
+    a = eval_expr(e.args[0], batch)
+    return Column(DOUBLE, _lane(a).astype(jnp.float64) / 1000.0, a.valid)
+
+
+def _date_format(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    pyfmt = _mysql_to_py_format(_const_str(e.args[1]))
+    ms = np.asarray(a.data).astype(np.int64)   # host materialization
+    if a.type is DATE or a.type.name == "date":
+        ms = ms * 86400000
+    # skip invalid slots: they hold arbitrary sentinels (e.g. the
+    # int64 min/max identities of window aggregates) that overflow
+    # timedelta
+    ok = (np.ones(ms.shape, bool) if a.valid is None
+          else np.asarray(a.valid))
+    epoch = _dt.datetime(1970, 1, 1)
+    out = [(epoch + _dt.timedelta(milliseconds=int(v))).strftime(pyfmt)
+           if k else "" for v, k in zip(ms, ok)]
+    dic, codes = StringDictionary.from_strings(out)
+    return Column(e.type, jnp.asarray(codes), a.valid, dic)
+
+
+def _date_parse(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    pyfmt = _mysql_to_py_format(_const_str(e.args[1]))
+    epoch = _dt.datetime(1970, 1, 1)
+
+    def parse(v: str):
+        try:
+            dt = _dt.datetime.strptime(v, pyfmt)
+        except ValueError:
+            return None
+        return int((dt - epoch).total_seconds() * 1000)
+
+    return _dict_transform(a, parse, e.type)
+
+
+# ---- JSON (operator/scalar/JsonFunctions.java; JSON values travel as
+# varchar — the reference's JSON type is a thin wrapper over a slice) ---
+
+_JSON_TOKEN = None
+
+
+def _json_path_tokens(path: str):
+    """Tokenize a JSONPath subset: $.field, $.a.b, $[0], $.a[2].b —
+    the shapes JsonExtract.java's generated extractors cover. Raises
+    on anything else (the reference's INVALID_FUNCTION_ARGUMENT for
+    unsupported paths, never silent misreads)."""
+    import re as _re
+    tok_re = _re.compile(r"\.([A-Za-z_][A-Za-z0-9_]*)|\[(\d+)\]")
+    toks = []
+    i = 0
+    while i < len(path):
+        m = tok_re.match(path, i)
+        if m is None:
+            raise EvalError(f"invalid JSON path: '${path}'")
+        toks.append(m.groups())
+        i = m.end()
+    return toks
+
+
+def _json_path_get(doc, toks):
+    cur = doc
+    for name, idx in toks:
+        if name:
+            if not isinstance(cur, dict) or name not in cur:
+                return None
+            cur = cur[name]
+        else:
+            i = int(idx)
+            if not isinstance(cur, list) or i >= len(cur):
+                return None
+            cur = cur[i]
+    return cur
+
+
+def _json_fn(kind: str):
+    def h(e, batch):
+        import json as _json
+        a = eval_expr(e.args[0], batch)
+        path = _const_str(e.args[1]) if len(e.args) > 1 else "$"
+        if not path.startswith("$"):
+            raise EvalError(f"invalid JSON path: {path}")
+        toks = _json_path_tokens(path[1:])
+
+        def f(v: str):
+            try:
+                doc = _json.loads(v)
+            except ValueError:
+                return None
+            got = _json_path_get(doc, toks)
+            if kind == "scalar":
+                if got is None or isinstance(got, (dict, list)):
+                    return None
+                if isinstance(got, bool):
+                    return "true" if got else "false"
+                return str(got)
+            if kind == "extract":
+                return None if got is None else _json.dumps(got)
+            if kind == "array_length":
+                return len(got) if isinstance(got, list) else None
+            if kind == "size":
+                if got is None:
+                    return None
+                return len(got) if isinstance(got, (list, dict)) else 0
+            return None
+        return _dict_transform(a, f, e.type)
+    return h
+
+
 # ---- arrays --------------------------------------------------------------
 # spi/block/ArrayBlock redesigned: per-row (start, length) lanes over a
 # flat elements Column (columnar.py Column.elements)
@@ -1176,4 +1346,10 @@ _DISPATCH: Dict[str, Callable] = {
     "date_add": _date_add,
     "$array": _array_ctor, "cardinality": _cardinality,
     "element_at": _element_at,
+    "from_unixtime": _from_unixtime, "to_unixtime": _to_unixtime,
+    "date_format": _date_format, "date_parse": _date_parse,
+    "json_extract_scalar": _json_fn("scalar"),
+    "json_extract": _json_fn("extract"),
+    "json_array_length": _json_fn("array_length"),
+    "json_size": _json_fn("size"),
 }

@@ -166,6 +166,24 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
             last_pos = pos  # running frame: current row
         last_pos = jnp.clip(last_pos, 0, cap - 1)
         return jnp.take(vals, last_pos), jnp.take(valid_lane, last_pos)
+    if k == "nth_value":
+        # value at the n-th row of the frame (operator/window/
+        # NthValueFunction.java): NULL when n exceeds the frame
+        if fn.offset is None:
+            raise ValueError("nth_value() requires a position argument")
+        ocol = src.column(fn.offset)
+        nth = jnp.take(jnp.asarray(ocol.data).astype(jnp.int64), order)
+        start = jnp.take(part_start, pid)
+        tgt = start + nth - 1
+        frame_end = (start + jnp.take(part_size, pid) - 1
+                     if unbounded_end else pos)
+        in_frame = (nth >= 1) & (tgt <= frame_end)
+        tgt_c = jnp.clip(tgt, 0, cap - 1)
+        data = jnp.take(vals, tgt_c)
+        valid = in_frame & jnp.take(valid_lane, tgt_c)
+        if ocol.valid is not None:
+            valid = valid & jnp.take(jnp.asarray(ocol.valid), order)
+        return data, valid
     if k in ("lag", "lead"):
         off_valid = None
         if fn.offset is not None:

@@ -181,7 +181,7 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "current_date": lambda n, a: DATE,
     "now": lambda n, a: TimestampType(3),
     "from_unixtime": lambda n, a: TimestampType(3),
-    "to_unixtime": _double_fn,
+    "to_unixtime": lambda n, a: DOUBLE,
     "date_format": _varchar_fn,
     "date_parse": lambda n, a: TimestampType(3),
     # misc
@@ -193,6 +193,11 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     # arrays (operator/scalar/ArrayFunctions + ArraySubscript)
     "cardinality": _bigint_fn,
     "element_at": lambda n, a: _array_elem(n, a),
+    # JSON (operator/scalar/JsonFunctions.java)
+    "json_extract_scalar": _varchar_fn,
+    "json_extract": _varchar_fn,
+    "json_array_length": _bigint_fn,
+    "json_size": _bigint_fn,
 }
 
 

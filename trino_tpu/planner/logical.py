@@ -629,6 +629,19 @@ class LogicalPlanner:
                         off_sym = to_sym(args[1], "winoff")
                     if len(args) > 2:
                         def_sym = to_sym(args[2], "windef")
+                elif call.name == "nth_value":
+                    # nth_value(x, n): second argument is the position
+                    if len(args) < 2:
+                        raise PlanningError(
+                            "nth_value requires a position argument")
+                    n_ex = ctx.rewrite(args[1])
+                    if isinstance(n_ex, Const) and \
+                            n_ex.value is not None and \
+                            int(n_ex.value) <= 0:
+                        raise PlanningError(
+                            "Argument of nth_value must be a positive "
+                            "integer")
+                    off_sym = as_sym(n_ex, "winoff")
             if is_window(call.name):
                 rtype = {"row_number": BIGINT, "rank": BIGINT,
                          "dense_rank": BIGINT, "ntile": BIGINT,
@@ -1401,12 +1414,12 @@ def _plan_literal(e: A.Literal) -> Const:
             return Const(d.toordinal()
                          - datetime.date(1970, 1, 1).toordinal(), DATE)
         if isinstance(t, TimestampType):
-            import datetime
-            s = str(v).strip()
-            dt = datetime.datetime.fromisoformat(s)
-            epoch = datetime.datetime(1970, 1, 1)
-            millis = int((dt - epoch).total_seconds() * 1000)
-            return Const(millis, t)
+            from ..types import iso_timestamp_millis
+            return Const(iso_timestamp_millis(str(v)), t)
+        from ..types import TimeType as _TimeType
+        if isinstance(t, _TimeType):
+            from ..types import iso_time_millis
+            return Const(iso_time_millis(str(v)), t)
         if isinstance(t, DecimalType):
             return Const(v, t)
         return Const(v, t)

@@ -152,6 +152,38 @@ class TimestampType(Type):
         return np.dtype(np.int64)
 
 
+def iso_timestamp_millis(s: str) -> int:
+    """ISO timestamp text -> epoch milliseconds (shared by literal
+    planning and varchar casts so the conversions cannot diverge)."""
+    import datetime
+    dt = datetime.datetime.fromisoformat(s.strip())
+    epoch = datetime.datetime(1970, 1, 1)
+    return int((dt - epoch).total_seconds() * 1000)
+
+
+def iso_time_millis(s: str) -> int:
+    """ISO time text -> milliseconds of day."""
+    import datetime
+    t = datetime.time.fromisoformat(s.strip())
+    return (((t.hour * 60 + t.minute) * 60 + t.second) * 1000
+            + t.microsecond // 1000)
+
+
+@dataclass(frozen=True)
+class TimeType(Type):
+    """TIME(p): milliseconds of day in an int64 lane
+    (spi/type/TimeType.java)."""
+    precision: int = 3
+
+    def __init__(self, precision: int = 3):
+        object.__setattr__(self, "name", f"time({precision})")
+        object.__setattr__(self, "precision", precision)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
 @dataclass(frozen=True)
 class ArrayType(Type):
     element: Type = None  # type: ignore
@@ -283,4 +315,6 @@ def parse_type(s: str) -> Type:
         return CharType(int(p1 or 1))
     if base == "timestamp":
         return TimestampType(int(p1) if p1 else 3)
+    if base == "time":
+        return TimeType(int(p1) if p1 else 3)
     raise ValueError(f"unknown type: {s!r}")
