@@ -171,12 +171,20 @@ def main():
     tpu_micro, micro_err = _probe_subprocess({}, mode="micro")
 
     if not tpu_eng and not tpu_micro:
+        # device unreachable: report the failure, but still record the
+        # CPU legs so the round has diagnostic numbers
+        cpu_eng, _ = _probe_subprocess(cpu_env, iters=2, mode="engine")
+        cpu_micro, _ = _probe_subprocess(cpu_env, iters=2, mode="micro")
         print(json.dumps({"metric": "tpch_q1_sf1_engine_rows_per_sec",
                           "value": 0.0, "unit": "rows/s",
                           "vs_baseline": 0.0,
                           "error": (eng_err or micro_err
                                     or "unknown")[:400],
-                          "attempts": TPU_ATTEMPTS}))
+                          "attempts": TPU_ATTEMPTS,
+                          "cpu_engine_rows_per_sec":
+                              round(cpu_eng or 0.0, 1),
+                          "cpu_micro_rows_per_sec":
+                              round(cpu_micro or 0.0, 1)}))
         return
 
     # --- CPU-worker baseline legs (north-star denominator) ------------
