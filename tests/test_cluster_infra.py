@@ -1,0 +1,304 @@
+"""Cluster infrastructure: events, resource groups, system.runtime,
+kill_query, security, failure detection, web UI, graceful drain.
+
+Reference parity: spi/eventlistener + event/QueryMonitor,
+execution/resourcegroups/InternalResourceGroup,
+connector/system (QuerySystemTable / KillQueryProcedure),
+server/security + security/AccessControlManager,
+failuredetector/HeartbeatFailureDetector, server/ui,
+server/GracefulShutdownHandler.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trino_tpu.exec import QueryError
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.security import (AccessDeniedError, AccessRule,
+                                InMemoryPasswordAuthenticator,
+                                RuleBasedAccessControl,
+                                load_password_file)
+from trino_tpu.server.coordinator import Coordinator
+from trino_tpu.server.events import (EventListener, EventListenerManager,
+                                     QueryCompletedEvent,
+                                     QueryCreatedEvent)
+from trino_tpu.server.failure import HeartbeatFailureDetector
+from trino_tpu.server.resourcegroups import (QueryQueueFullError,
+                                             ResourceGroup,
+                                             ResourceGroupManager)
+
+
+def _get(uri, headers=None):
+    req = urllib.request.Request(uri, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=10) as r:
+        body = r.read()
+    return r.status, body
+
+
+def _post(uri, data, headers=None):
+    req = urllib.request.Request(uri, data=data.encode(),
+                                 headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+def _run_sql(base, sql, headers=None):
+    out = _post(base + "/v1/statement", sql, headers)
+    while "nextUri" in out:
+        _, body = _get(out["nextUri"], headers)
+        out = json.loads(body)
+    return out
+
+
+# --- events ---------------------------------------------------------------
+
+class _Recorder(EventListener):
+    def __init__(self):
+        self.created = []
+        self.completed = []
+
+    def query_created(self, event):
+        self.created.append(event)
+
+    def query_completed(self, event):
+        self.completed.append(event)
+
+
+def test_event_listener_lifecycle():
+    rec = _Recorder()
+    co = Coordinator(event_listeners=[rec]).start()
+    try:
+        out = _run_sql(co.base_uri, "SELECT 1")
+        assert out["stats"]["state"] == "FINISHED"
+        deadline = time.time() + 5
+        while not rec.completed and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(rec.created) == 1
+        assert isinstance(rec.created[0], QueryCreatedEvent)
+        done = rec.completed[0]
+        assert isinstance(done, QueryCompletedEvent)
+        assert done.state == "FINISHED" and done.rows == 1
+    finally:
+        co.stop()
+
+
+def test_event_listener_error_isolated():
+    class Bomb(EventListener):
+        def query_created(self, event):
+            raise RuntimeError("boom")
+    mgr = EventListenerManager()
+    mgr.add_listener(Bomb())
+    mgr.query_created(QueryCreatedEvent("q", "SELECT 1", "u", None,
+                                        None))   # must not raise
+
+
+# --- resource groups ------------------------------------------------------
+
+def test_resource_group_concurrency_and_queueing():
+    mgr = ResourceGroupManager()
+    g = mgr.root.add(ResourceGroup("small", hard_concurrency=1,
+                                   max_queued=1))
+    mgr.add_selector(g, user_regex="alice")
+    order = []
+
+    def first(group):
+        order.append("first")
+
+    def second(group):
+        order.append("second")
+
+    grp, started = mgr.submit("alice", "", first)
+    assert started and order == ["first"]
+    grp2, started2 = mgr.submit("alice", "", second)
+    assert not started2 and order == ["first"]     # queued
+    with pytest.raises(QueryQueueFullError):
+        mgr.submit("alice", "", lambda group: None)    # queue full
+    mgr.query_finished(grp)
+    assert order == ["first", "second"]
+    mgr.query_finished(grp2)
+    assert g.running == 0
+
+
+def test_resource_group_from_config_and_selectors():
+    mgr = ResourceGroupManager.from_config({
+        "rootGroups": [
+            {"name": "adhoc", "hardConcurrencyLimit": 5},
+            {"name": "etl", "hardConcurrencyLimit": 2,
+             "subGroups": [{"name": "nightly"}]},
+        ],
+        "selectors": [
+            {"user": "etl_.*", "group": "etl.nightly"},
+            {"group": "adhoc"},
+        ]})
+    assert mgr.select("etl_loader").full_name == "global.etl.nightly"
+    assert mgr.select("bob").full_name == "global.adhoc"
+
+
+def test_resource_groups_on_coordinator():
+    mgr = ResourceGroupManager()
+    g = mgr.root.add(ResourceGroup("all", hard_concurrency=2))
+    mgr.add_selector(g)
+    co = Coordinator(resource_groups=mgr).start()
+    try:
+        out = _run_sql(co.base_uri, "SELECT count(*) FROM "
+                                    "tpch.tiny.nation")
+        assert out["data"] == [[25]]
+        rows = _run_sql(co.base_uri,
+                        "SELECT name, hard_concurrency_limit FROM "
+                        "system.runtime.resource_groups "
+                        "WHERE name = 'global.all'")
+        assert rows["data"] == [["global.all", 2]]
+    finally:
+        co.stop()
+
+
+# --- system.runtime + kill_query ------------------------------------------
+
+def test_system_runtime_queries_and_nodes():
+    co = Coordinator().start()
+    try:
+        _run_sql(co.base_uri, "SELECT 42")
+        out = _run_sql(co.base_uri,
+                       "SELECT state, query FROM "
+                       "system.runtime.queries "
+                       "WHERE query LIKE '%42%'")
+        states = [r[0] for r in out["data"]]
+        assert "FINISHED" in states
+        nodes = _run_sql(co.base_uri, "SELECT node_id, coordinator "
+                                      "FROM system.runtime.nodes")
+        assert nodes["data"][0][1] is True
+    finally:
+        co.stop()
+
+
+def test_kill_query_procedure():
+    co = Coordinator().start()
+    try:
+        # a long query: big cross join aggregated
+        slow_sql = ("SELECT count(*) FROM tpch.sf1.lineitem a, "
+                    "tpch.sf1.lineitem b WHERE a.l_orderkey = "
+                    "b.l_orderkey AND a.l_suppkey + b.l_suppkey > 1")
+        out = _post(co.base_uri + "/v1/statement", slow_sql)
+        qid = out["id"]
+        killed = _run_sql(
+            co.base_uri,
+            f"CALL system.runtime.kill_query('{qid}')")
+        assert killed.get("error") is None
+        deadline = time.time() + 20
+        q = co.tracker.get(qid)
+        while q.state not in ("CANCELED", "FINISHED", "FAILED") \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert q.state in ("CANCELED", "FINISHED")
+    finally:
+        co.stop()
+
+
+# --- security -------------------------------------------------------------
+
+def test_password_authenticator():
+    auth = InMemoryPasswordAuthenticator({"alice": "secret"})
+    assert auth.authenticate("alice", "secret")
+    assert not auth.authenticate("alice", "wrong")
+    assert not auth.authenticate("bob", "secret")
+    auth2 = load_password_file("bob:pw123\n# comment\n")
+    assert auth2.authenticate("bob", "pw123")
+
+
+def test_http_basic_auth():
+    import base64
+    auth = InMemoryPasswordAuthenticator({"alice": "secret"})
+    co = Coordinator(authenticator=auth).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(co.base_uri + "/v1/info")
+        assert e.value.code == 401
+        cred = base64.b64encode(b"alice:secret").decode()
+        status, _ = _get(co.base_uri + "/v1/info",
+                         {"Authorization": f"Basic {cred}"})
+        assert status == 200
+    finally:
+        co.stop()
+
+
+def test_access_control_rules():
+    ac = RuleBasedAccessControl([
+        AccessRule(user="alice", table=r"tpch\..*",
+                   privileges=("select",)),
+    ])
+    ac.check_can_select("alice", "tpch", "tiny", "nation")
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_select("bob", "tpch", "tiny", "nation")
+    with pytest.raises(AccessDeniedError):
+        ac.check_can_insert("alice", "tpch", "tiny", "nation")
+
+
+def test_access_control_enforced_in_engine():
+    from trino_tpu.session import Session
+    runner = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny", user="bob"))
+    runner.catalogs.access_control = RuleBasedAccessControl([
+        AccessRule(user="alice", table=".*"),
+    ])
+    with pytest.raises(QueryError, match="Access Denied"):
+        runner.execute("SELECT * FROM tpch.tiny.nation")
+    runner.session.user = "alice"
+    assert len(runner.execute(
+        "SELECT * FROM tpch.tiny.region").rows) == 5
+
+
+# --- failure detector -----------------------------------------------------
+
+def test_failure_detector_decay():
+    health = {"w1": True, "w2": True}
+    det = HeartbeatFailureDetector(
+        probe=lambda uri: health[uri], warmup_probes=2)
+    det.add_service("w1")
+    det.add_service("w2")
+    for _ in range(5):
+        det.probe_once()
+    assert det.is_alive("w1") and det.is_alive("w2")
+    health["w2"] = False
+    for _ in range(10):
+        det.probe_once()
+    assert det.is_alive("w1")
+    assert not det.is_alive("w2")
+    assert det.failed() == ["w2"]
+
+
+def test_failure_detector_http_probe():
+    co = Coordinator().start()
+    det = HeartbeatFailureDetector()
+    det.add_service(co.base_uri)
+    det.add_service("http://127.0.0.1:1")      # nothing listens
+    for _ in range(5):
+        det.probe_once()
+    assert det.is_alive(co.base_uri)
+    assert not det.is_alive("http://127.0.0.1:1")
+    co.stop()
+
+
+# --- web UI + cluster stats + drain ---------------------------------------
+
+def test_web_ui_and_cluster_stats():
+    co = Coordinator().start()
+    try:
+        status, body = _get(co.base_uri + "/ui")
+        assert status == 200 and b"trino-tpu" in body
+        _run_sql(co.base_uri, "SELECT 1")
+        status, body = _get(co.base_uri + "/v1/cluster")
+        stats = json.loads(body)
+        assert stats["totalQueries"] >= 1
+    finally:
+        co.stop()
+
+
+def test_graceful_drain():
+    co = Coordinator().start()
+    _run_sql(co.base_uri, "SELECT 1")
+    assert co.drain(timeout=10.0)

@@ -1,0 +1,194 @@
+"""MAP / ROW types + higher-order (lambda) functions.
+
+Reference parity: spi/block/MapBlock.java / RowBlock.java,
+operator/scalar/MapFunctions.java, ArrayTransformFunction.java,
+ArrayFilterFunction, ReduceFunction, ZipWithFunction,
+MapFilterFunction / MapTransformKeysFunction / MapTransformValuesFunction
+(SURVEY.md Appendix A.10).
+"""
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+# --- MAP ------------------------------------------------------------------
+
+def test_map_constructor_and_subscript(runner):
+    assert q(runner, "SELECT map(ARRAY[1, 2], ARRAY['a', 'b'])[2]") == \
+        [['b']]
+    assert q(runner,
+             "SELECT element_at(map(ARRAY['x','y'], ARRAY[10,20]), 'y')"
+             ) == [[20]]
+    assert q(runner,
+             "SELECT element_at(map(ARRAY[1], ARRAY[5]), 9)") == [[None]]
+
+
+def test_map_materialization(runner):
+    assert q(runner, "SELECT map(ARRAY[1, 2], ARRAY[10, 20])") == \
+        [[{1: 10, 2: 20}]]
+
+
+def test_map_keys_values_cardinality(runner):
+    got = q(runner, "SELECT map_keys(m), map_values(m), cardinality(m) "
+                    "FROM (SELECT map(ARRAY[3, 1], ARRAY['c', 'a']) "
+                    "AS m) t")
+    assert got == [[[3, 1], ['c', 'a'], 2]]
+
+
+def test_map_concat(runner):
+    got = q(runner, "SELECT map_concat(map(ARRAY[1, 2], ARRAY[10, 20]),"
+                    " map(ARRAY[2, 3], ARRAY[99, 30]))")
+    assert got == [[{1: 10, 2: 99, 3: 30}]]
+
+
+def test_map_entries(runner):
+    got = q(runner, "SELECT map_entries(map(ARRAY[1], ARRAY['a']))")
+    assert got == [[[[1, 'a']]]]
+
+
+def test_map_per_row(runner):
+    got = q(runner, "SELECT map(ARRAY[n_nationkey], "
+                    "ARRAY[n_regionkey])[n_nationkey] "
+                    "FROM tpch.tiny.nation WHERE n_nationkey < 3 "
+                    "ORDER BY n_nationkey")
+    assert got == [[0], [1], [1]]
+
+
+# --- ROW ------------------------------------------------------------------
+
+def test_row_constructor_subscript(runner):
+    assert q(runner, "SELECT ROW(1, 'x')[1], ROW(1, 'x')[2]") == \
+        [[1, 'x']]
+
+
+def test_row_materialization(runner):
+    assert q(runner, "SELECT ROW(1, 2.5)") == [[[1, 2.5]]]
+
+
+def test_row_cast_and_dereference(runner):
+    got = q(runner, "SELECT CAST(ROW(1, 'a') AS "
+                    "ROW(x BIGINT, y VARCHAR)).x")
+    assert got == [[1]]
+
+
+# --- lambdas --------------------------------------------------------------
+
+def test_transform(runner):
+    assert q(runner, "SELECT transform(ARRAY[1, 2, 3], x -> x * 10)") \
+        == [[[10, 20, 30]]]
+
+
+def test_transform_captures_outer_column(runner):
+    got = q(runner, "SELECT transform(ARRAY[1, 2], "
+                    "x -> x + n_nationkey) FROM tpch.tiny.nation "
+                    "WHERE n_nationkey < 2 ORDER BY n_nationkey")
+    assert got == [[[1, 2]], [[2, 3]]]
+
+
+def test_filter(runner):
+    assert q(runner,
+             "SELECT filter(ARRAY[5, -1, 3, -7], x -> x > 0)") == \
+        [[[5, 3]]]
+
+
+def test_matches(runner):
+    got = q(runner, "SELECT any_match(ARRAY[1, 2], x -> x > 1), "
+                    "all_match(ARRAY[1, 2], x -> x > 0), "
+                    "none_match(ARRAY[1, 2], x -> x > 5)")
+    assert got == [[True, True, True]]
+
+
+def test_reduce(runner):
+    assert q(runner, "SELECT reduce(ARRAY[1, 2, 3, 4], 0, "
+                     "(s, x) -> s + x, s -> s)") == [[10]]
+    assert q(runner, "SELECT reduce(ARRAY[2, 3], 1, "
+                     "(s, x) -> s * x, s -> s * 100)") == [[600]]
+
+
+def test_zip_with(runner):
+    assert q(runner, "SELECT zip_with(ARRAY[1, 2], ARRAY[10, 20], "
+                     "(x, y) -> x + y)") == [[[11, 22]]]
+
+
+def test_map_filter_transform(runner):
+    assert q(runner, "SELECT map_filter(map(ARRAY[1, 2, 3], "
+                     "ARRAY[10, 20, 30]), (k, v) -> k % 2 = 1)") == \
+        [[{1: 10, 3: 30}]]
+    assert q(runner, "SELECT transform_values(map(ARRAY[1], ARRAY[5]), "
+                     "(k, v) -> v * k)") == [[{1: 5}]]
+    assert q(runner, "SELECT transform_keys(map(ARRAY[1], ARRAY[5]), "
+                     "(k, v) -> k + 100)") == [[{101: 5}]]
+
+
+# --- array scalar breadth -------------------------------------------------
+
+def test_contains_position(runner):
+    got = q(runner, "SELECT contains(ARRAY[1, 2, 3], 2), "
+                    "contains(ARRAY[1, 3], 2), "
+                    "array_position(ARRAY[7, 8, 9], 9)")
+    assert got == [[True, False, 3]]
+
+
+def test_array_min_max_distinct_sort(runner):
+    got = q(runner, "SELECT array_min(ARRAY[3, 1, 2]), "
+                    "array_max(ARRAY[3, 1, 2]), "
+                    "array_distinct(ARRAY[1, 2, 1, 3, 2]), "
+                    "array_sort(ARRAY[3, 1, 2])")
+    assert got == [[1, 3, [1, 2, 3], [1, 2, 3]]]
+
+
+def test_slice_sequence_repeat_flatten(runner):
+    got = q(runner, "SELECT slice(ARRAY[1, 2, 3, 4], 2, 2), "
+                    "sequence(1, 4), repeat(7, 3), "
+                    "flatten(ARRAY[ARRAY[1, 2], ARRAY[3]])")
+    assert got == [[[2, 3], [1, 2, 3, 4], [7, 7, 7], [1, 2, 3]]]
+
+
+def test_array_setops(runner):
+    got = q(runner, "SELECT array_union(ARRAY[1, 2], ARRAY[2, 3]), "
+                    "array_intersect(ARRAY[1, 2, 3], ARRAY[2, 3, 4]), "
+                    "array_except(ARRAY[1, 2, 3], ARRAY[2]), "
+                    "arrays_overlap(ARRAY[1, 2], ARRAY[2, 9])")
+    assert got == [[[1, 2, 3], [2, 3], [1, 3], True]]
+
+
+def test_map_agg(runner):
+    got = q(runner, "SELECT map_agg(n_nationkey, n_name) "
+                    "FROM tpch.tiny.nation WHERE n_nationkey < 3")
+    assert got == [[{0: 'ALGERIA', 1: 'ARGENTINA', 2: 'BRAZIL'}]]
+
+
+def test_map_agg_grouped(runner):
+    got = q(runner, "SELECT n_regionkey, map_agg(n_nationkey, n_name) "
+                    "FROM tpch.tiny.nation WHERE n_nationkey < 5 "
+                    "GROUP BY n_regionkey ORDER BY n_regionkey")
+    assert got == [[0, {0: 'ALGERIA'}],
+                   [1, {1: 'ARGENTINA', 2: 'BRAZIL', 3: 'CANADA'}],
+                   [4, {4: 'EGYPT'}]]
+
+
+def test_histogram(runner):
+    assert q(runner, "SELECT histogram(n_regionkey) "
+                     "FROM tpch.tiny.nation") == \
+        [[{0: 5, 1: 5, 2: 5, 3: 5, 4: 5}]]
+    got = q(runner, "SELECT n_regionkey, histogram(n_regionkey % 2) "
+                    "FROM tpch.tiny.nation GROUP BY n_regionkey "
+                    "ORDER BY n_regionkey")
+    assert got == [[0, {0: 5}], [1, {1: 5}], [2, {0: 5}], [3, {1: 5}],
+                   [4, {0: 5}]]
+
+
+def test_lambda_in_where(runner):
+    got = q(runner, "SELECT n_name FROM tpch.tiny.nation "
+                    "WHERE any_match(ARRAY[n_nationkey], x -> x = 3)")
+    assert got == [['CANADA']]

@@ -1,0 +1,156 @@
+"""Parquet reader (from scratch) + local-file connector.
+
+Reference parity: lib/trino-parquet (reader-only at the snapshot),
+plugin/trino-local-file, lib/trino-record-decoder. Test files are
+generated with pyarrow — an INDEPENDENT writer — so the reader is
+validated against real third-party output, not a round-trip of itself.
+"""
+
+import datetime
+import json
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.parquet as pq  # noqa: E402
+
+from trino_tpu.catalog import CatalogManager  # noqa: E402
+from trino_tpu.connectors.localfile import LocalFileConnector  # noqa
+from trino_tpu.connectors.memory import MemoryConnector  # noqa: E402
+from trino_tpu.formats.parquet import (read_metadata, read_parquet,
+                                       snappy_decompress)  # noqa: E402
+from trino_tpu.runner import LocalQueryRunner  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def datadir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("files")
+    n = 1000
+    rng = np.random.default_rng(0)
+    table = pa.table({
+        "id": pa.array(np.arange(n, dtype=np.int64)),
+        "qty": pa.array(rng.integers(0, 50, n).astype(np.int32)),
+        "price": pa.array(rng.uniform(1.0, 100.0, n)),
+        "flag": pa.array((np.arange(n) % 3 == 0)),
+        "name": pa.array([f"item_{i % 17}" for i in range(n)]),
+        "maybe": pa.array([None if i % 5 == 0 else i
+                           for i in range(n)], type=pa.int64()),
+        "day": pa.array([datetime.date(1995, 1, 1)
+                         + datetime.timedelta(days=int(i % 700))
+                         for i in range(n)]),
+    })
+    pq.write_table(table, d / "plain.parquet", compression="none",
+                   use_dictionary=False)
+    pq.write_table(table, d / "snappy.parquet", compression="snappy")
+    pq.write_table(table, d / "gzipped.parquet", compression="gzip")
+    pq.write_table(table, d / "grouped.parquet", compression="snappy",
+                   row_group_size=100)
+    with open(d / "people.csv", "w") as f:
+        f.write("name,age,score\nalice,30,1.5\nbob,25,2.25\n")
+    with open(d / "events.json", "w") as f:
+        f.write(json.dumps({"kind": "click", "n": 3}) + "\n")
+        f.write(json.dumps({"kind": "view", "n": 7}) + "\n")
+    return d
+
+
+def _expected(table_rows=1000):
+    rng = np.random.default_rng(0)
+    qty = rng.integers(0, 50, table_rows).astype(np.int32)
+    price = rng.uniform(1.0, 100.0, table_rows)
+    return qty, price
+
+
+def test_snappy_roundtrip_against_reference_vectors():
+    # compress with pyarrow's real snappy, decompress with ours
+    import pyarrow as _pa
+    raw = b"trino-tpu snappy " * 100 + os.urandom(50)
+    comp = _pa.compress(raw, codec="snappy", asbytes=True)
+    assert snappy_decompress(comp) == raw
+
+
+@pytest.mark.parametrize("fname", ["plain.parquet", "snappy.parquet",
+                                   "gzipped.parquet",
+                                   "grouped.parquet"])
+def test_read_parquet_matches_pyarrow(datadir, fname):
+    path = str(datadir / fname)
+    got = read_parquet(path)
+    ref = pq.read_table(path).to_pydict()
+    n = got.num_rows_host()
+    assert n == 1000
+    rows = got.to_pylist()
+    names = list(got.columns)
+    for i in (0, 1, 499, 999):
+        for j, col in enumerate(names):
+            want = ref[col][i]
+            have = rows[i][j]
+            if isinstance(want, float):
+                assert have == pytest.approx(want)
+            else:
+                assert have == want, (col, i, have, want)
+
+
+def test_metadata_and_row_groups(datadir):
+    meta = read_metadata(str(datadir / "grouped.parquet"))
+    assert meta.num_rows == 1000
+    assert len(meta.row_groups) == 10
+    one = read_parquet(str(datadir / "grouped.parquet"), row_group=3)
+    assert one.num_rows_host() == 100
+
+
+def test_column_projection(datadir):
+    b = read_parquet(str(datadir / "snappy.parquet"),
+                     columns=["id", "name"])
+    assert list(b.columns) == ["id", "name"]
+
+
+def test_localfile_connector_sql(datadir):
+    runner = LocalQueryRunner()
+    runner.catalogs.register("files",
+                             LocalFileConnector(str(datadir)))
+    got = runner.execute("SELECT count(*), sum(qty) FROM "
+                         "files.default.snappy").rows
+    qty, _ = _expected()
+    assert got == [[1000, int(qty.sum())]]
+    # predicate + projection over parquet, with pushdown
+    got = runner.execute("SELECT count(*) FROM files.default.snappy "
+                         "WHERE flag AND qty > 25").rows
+    flag = np.arange(1000) % 3 == 0
+    assert got == [[int((flag & (qty > 25)).sum())]]
+    # split-per-row-group parallel scan agrees
+    got2 = runner.execute("SELECT count(*) FROM "
+                          "files.default.grouped "
+                          "WHERE flag AND qty > 25").rows
+    assert got2 == got
+    # nulls survive
+    got = runner.execute("SELECT count(*) FROM files.default.snappy "
+                         "WHERE maybe IS NULL").rows
+    assert got == [[200]]
+    # dates decode
+    got = runner.execute("SELECT min(day), max(day) FROM "
+                         "files.default.snappy").rows
+    assert got[0][0] == datetime.date(1995, 1, 1)
+
+
+def test_localfile_csv_json(datadir):
+    runner = LocalQueryRunner()
+    runner.catalogs.register("files",
+                             LocalFileConnector(str(datadir)))
+    assert runner.execute(
+        "SELECT name, age FROM files.default.people "
+        "ORDER BY age DESC").rows == [['alice', 30], ['bob', 25]]
+    assert runner.execute(
+        "SELECT sum(n) FROM files.default.events").rows == [[10]]
+    tables = {r[0] for r in runner.execute(
+        "SHOW TABLES FROM files.default").rows}
+    assert {"people", "events", "snappy"} <= tables
+
+
+def test_strings_and_varchar_agg(datadir):
+    runner = LocalQueryRunner()
+    runner.catalogs.register("files",
+                             LocalFileConnector(str(datadir)))
+    got = runner.execute(
+        "SELECT count(DISTINCT name) FROM files.default.plain").rows
+    assert got == [[17]]

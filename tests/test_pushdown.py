@@ -1,0 +1,150 @@
+"""TupleDomain predicate model + connector pushdown.
+
+Reference parity: spi/predicate/ (TupleDomain/Domain/Range),
+sql/planner/DomainTranslator.java,
+sql/planner/iterative/rule/PushPredicateIntoTableScan.java /
+PushLimitIntoTableScan.java.
+"""
+
+import pytest
+
+from trino_tpu.predicate import (Domain, Range, TupleDomain,
+                                 extract_tuple_domain)
+from trino_tpu.rex import Call, Const, InputRef
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.types import BIGINT, BOOLEAN, VARCHAR
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+# --- domain algebra -------------------------------------------------------
+
+def test_domain_intersect_union():
+    d1 = Domain.range(BIGINT, 0, True, 10, True)
+    d2 = Domain.range(BIGINT, 5, True, 20, True)
+    inter = d1.intersect(d2)
+    assert inter.ranges == (Range(5, True, 10, True),)
+    uni = d1.union(d2)
+    assert uni.ranges == (Range(0, True, 20, True),)
+    assert Domain.single(BIGINT, 3).intersect(
+        Domain.single(BIGINT, 4)).is_none()
+
+
+def test_domain_in_values_and_mask():
+    import numpy as np
+    d = Domain.in_values(BIGINT, [3, 1, 3, 7])
+    assert [r.low for r in d.ranges] == [1, 3, 7]
+    mask = d.mask_for(np.asarray([0, 1, 2, 3, 7, 8]))
+    assert list(mask) == [False, True, False, True, True, False]
+
+
+def test_tuple_domain_intersect():
+    td1 = TupleDomain.of({"a": Domain.single(BIGINT, 1)})
+    td2 = TupleDomain.of({"a": Domain.single(BIGINT, 2)})
+    assert td1.intersect(td2).is_none
+    td3 = TupleDomain.of({"b": Domain.not_null(BIGINT)})
+    merged = td1.intersect(td3)
+    assert set(merged.as_dict()) == {"a", "b"}
+
+
+def test_extract_tuple_domain():
+    types = {"x": BIGINT, "y": VARCHAR}
+    x = InputRef("x", BIGINT)
+    pred = Call("and", (
+        Call(">=", (x, Const(5, BIGINT)), BOOLEAN),
+        Call("<", (x, Const(10, BIGINT)), BOOLEAN)), BOOLEAN)
+    td, residual = extract_tuple_domain(pred, types)
+    assert not residual
+    dom = td.domain("x")
+    assert dom.ranges == (Range(5, True, 10, False),)
+    # untranslatable residual stays
+    pred2 = Call("and", (
+        Call("=", (x, Const(1, BIGINT)), BOOLEAN),
+        Call("like", (InputRef("y", VARCHAR), Const("a%", VARCHAR)),
+             BOOLEAN)), BOOLEAN)
+    td2, res2 = extract_tuple_domain(pred2, types)
+    assert td2.domain("x") is not None and len(res2) == 1
+
+
+# --- engine integration ---------------------------------------------------
+
+def test_pushdown_correctness_vs_no_pushdown(runner):
+    queries = [
+        "SELECT count(*) FROM tpch.tiny.lineitem WHERE l_quantity < 10",
+        "SELECT count(*) FROM tpch.tiny.orders WHERE "
+        "o_orderdate >= DATE '1995-01-01' AND "
+        "o_orderdate < DATE '1996-01-01'",
+        "SELECT count(*) FROM tpch.tiny.nation WHERE "
+        "n_name IN ('CANADA', 'BRAZIL')",
+        "SELECT count(*) FROM tpch.tiny.customer WHERE "
+        "c_mktsegment = 'BUILDING' AND c_custkey > 100",
+    ]
+    with_pd = [runner.execute(q).rows for q in queries]
+    runner.execute("SET SESSION pushdown_into_scan = false")
+    try:
+        without = [runner.execute(q).rows for q in queries]
+    finally:
+        runner.execute("SET SESSION pushdown_into_scan = true")
+    assert with_pd == without
+
+
+def test_pushdown_shows_in_plan(runner):
+    plan = runner.execute(
+        "EXPLAIN SELECT n_name FROM tpch.tiny.nation "
+        "WHERE n_nationkey = 3").rows
+    txt = "\n".join(r[0] for r in plan)
+    assert "constraint=" in txt
+    assert "Filter" not in txt      # fully enforced -> filter gone
+
+
+def test_residual_filter_stays(runner):
+    plan = runner.execute(
+        "EXPLAIN SELECT n_name FROM tpch.tiny.nation "
+        "WHERE n_nationkey = 3 AND n_comment LIKE '%a%'").rows
+    txt = "\n".join(r[0] for r in plan)
+    assert "constraint=" in txt and "Filter" in txt
+
+
+def test_limit_pushdown(runner):
+    plan = runner.execute(
+        "EXPLAIN SELECT n_name FROM tpch.tiny.nation LIMIT 3").rows
+    txt = "\n".join(r[0] for r in plan)
+    assert "limit=3" in txt
+    got = runner.execute(
+        "SELECT n_name FROM tpch.tiny.nation LIMIT 3").rows
+    assert len(got) == 3
+
+
+def test_memory_connector_pushdown(runner):
+    runner.execute("CREATE TABLE memory.default.pd AS "
+                   "SELECT * FROM tpch.tiny.region")
+    got = runner.execute("SELECT r_name FROM memory.default.pd "
+                         "WHERE r_regionkey = 2").rows
+    assert got == [['ASIA']]
+    got = runner.execute("SELECT r_name FROM memory.default.pd "
+                         "WHERE r_name < 'ASIA' ORDER BY r_name").rows
+    assert got == [['AFRICA'], ['AMERICA']]
+    runner.execute("DROP TABLE memory.default.pd")
+
+
+def test_contradiction_prunes_to_zero(runner):
+    got = runner.execute("SELECT count(*) FROM tpch.tiny.nation "
+                         "WHERE n_nationkey = 1 AND "
+                         "n_nationkey = 2").rows
+    assert got == [[0]]
+
+
+def test_pushdown_with_nulls(runner):
+    runner.execute("CREATE TABLE memory.default.pn (x bigint)")
+    runner.execute("INSERT INTO memory.default.pn VALUES (1), (NULL), "
+                   "(3)")
+    assert runner.execute("SELECT count(*) FROM memory.default.pn "
+                          "WHERE x > 0").rows == [[2]]
+    assert runner.execute("SELECT count(*) FROM memory.default.pn "
+                          "WHERE x IS NULL").rows == [[1]]
+    assert runner.execute("SELECT count(*) FROM memory.default.pn "
+                          "WHERE x IS NOT NULL").rows == [[2]]
+    runner.execute("DROP TABLE memory.default.pn")

@@ -1,0 +1,39 @@
+"""Scale-ladder runs: BASELINE.json configs[2] (q3 @ sf10) and
+configs[3] (q18 @ sf100).
+
+Gated behind TRINO_TPU_SCALE_TESTS=1 — on the 1-core CI box these
+take minutes (sf10) to tens of minutes (sf100); the point is
+completing WITHOUT out-of-memory, exercising the memory guard +
+split-streaming + chunked-join machinery (reference:
+HashBuilderOperator spill state machine,
+execution/MemoryRevokingScheduler).
+"""
+
+import os
+
+import pytest
+
+from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+from trino_tpu.runner import LocalQueryRunner
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TRINO_TPU_SCALE_TESTS") != "1",
+    reason="scale tests are opt-in (TRINO_TPU_SCALE_TESTS=1)")
+
+
+def test_q3_sf10():
+    runner = LocalQueryRunner()
+    runner.execute("USE tpch.sf10")
+    res = runner.execute(TPCH_QUERIES[3])
+    assert len(res.rows) == 10
+    # top row is the largest revenue; q3@sf10 revenue ~ 4e5..6e5
+    assert res.rows[0][1] > 1e5
+
+
+def test_q18_sf100():
+    runner = LocalQueryRunner()
+    runner.execute("USE tpch.sf100")
+    res = runner.execute(TPCH_QUERIES[18])
+    assert len(res.rows) <= 100
+    for row in res.rows:
+        assert row[-1] > 300     # sum(l_quantity) > 300 per the query

@@ -1,0 +1,134 @@
+"""Verifier, proxy, and server bootstrap/config services.
+
+Reference parity: service/trino-verifier (PrestoVerifier),
+service/trino-proxy (ProxyResource), core/trino-server-main bootstrap
++ airlift etc/config.properties + etc/catalog/*.properties loading.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from trino_tpu.client import StatementClient
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.server.coordinator import Coordinator
+from trino_tpu.server.main import build_catalogs, load_properties
+from trino_tpu.server.proxy import Proxy
+from trino_tpu.verifier import Verifier, report, rows_match
+
+
+# --- verifier -------------------------------------------------------------
+
+def test_rows_match_tolerance_and_order():
+    assert rows_match([[1, 2.0]], [[1, 2.0 + 1e-12]]) is None
+    assert rows_match([[1], [2]], [[2], [1]]) is None          # unordered
+    assert rows_match([[1], [2]], [[2], [1]], ordered=True)
+    assert "row count" in rows_match([[1]], [[1], [2]])
+    assert rows_match([[None]], [[None]]) is None
+    assert rows_match([[None]], [[1]])
+
+
+def test_verifier_local_vs_distributed():
+    control = LocalQueryRunner()
+    test = LocalQueryRunner(distributed=True)
+    v = Verifier(control, test, rel_tol=1e-9)
+    results = v.run_suite([
+        "SELECT count(*) FROM tpch.tiny.nation",
+        "SELECT n_regionkey, count(*) FROM tpch.tiny.nation "
+        "GROUP BY n_regionkey ORDER BY n_regionkey",
+        "SELECT sum(l_extendedprice * l_discount) FROM "
+        "tpch.tiny.lineitem WHERE l_quantity < 10",
+    ])
+    assert all(r.status == "MATCH" for r in results), \
+        report(results)
+
+
+def test_verifier_detects_mismatch():
+    class Fake:
+        def __init__(self, rows):
+            self._rows = rows
+
+        def execute(self, sql):
+            class R:
+                rows = self._rows
+            return R()
+    v = Verifier(Fake([[1]]), Fake([[2]]))
+    r = v.verify("SELECT 1")
+    assert r.status == "MISMATCH" and "1" in r.detail
+
+
+def test_verifier_error_classification():
+    good = LocalQueryRunner()
+
+    class Broken:
+        def execute(self, sql):
+            raise RuntimeError("down")
+    assert Verifier(good, Broken()).verify(
+        "SELECT 1").status == "TEST_ERROR"
+    assert Verifier(Broken(), good).verify(
+        "SELECT 1").status == "CONTROL_ERROR"
+
+
+# --- proxy ----------------------------------------------------------------
+
+def test_proxy_forwards_and_rewrites():
+    co = Coordinator().start()
+    px = Proxy(co.base_uri).start()
+    try:
+        client = StatementClient(px.base_uri)
+        res = client.execute("SELECT count(*) FROM tpch.tiny.region")
+        assert res.rows == [[5]]
+        # nextUri rewriting: poll through the proxy only
+        req = urllib.request.Request(
+            px.base_uri + "/v1/statement", data=b"SELECT 1",
+            method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            out = json.loads(r.read())
+        next_uri = out.get("nextUri", "")
+        assert co.base_uri not in next_uri
+    finally:
+        px.stop()
+        co.stop()
+
+
+def test_proxy_shared_secret():
+    co = Coordinator().start()
+    px = Proxy(co.base_uri, shared_secret="s3cret").start()
+    try:
+        req = urllib.request.Request(px.base_uri + "/v1/info")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 403
+        req = urllib.request.Request(
+            px.base_uri + "/v1/info",
+            headers={"X-Proxy-Secret": "s3cret"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+    finally:
+        px.stop()
+        co.stop()
+
+
+# --- config / bootstrap ---------------------------------------------------
+
+def test_load_properties(tmp_path):
+    p = tmp_path / "config.properties"
+    p.write_text("# comment\nhttp-server.http.port=8099\n"
+                 "coordinator=true\n")
+    props = load_properties(str(p))
+    assert props == {"http-server.http.port": "8099",
+                     "coordinator": "true"}
+
+
+def test_build_catalogs_from_etc(tmp_path):
+    cat = tmp_path / "catalog"
+    cat.mkdir()
+    (cat / "analytics.properties").write_text("connector.name=tpch\n")
+    (cat / "scratch.properties").write_text("connector.name=memory\n")
+    mgr = build_catalogs(str(tmp_path))
+    assert set(mgr.list_catalogs()) == {"analytics", "scratch"}
+    runner = LocalQueryRunner(catalogs=mgr)
+    assert runner.execute("SELECT count(*) FROM "
+                          "analytics.tiny.region").rows == [[5]]

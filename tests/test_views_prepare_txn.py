@@ -1,0 +1,124 @@
+"""Views, prepared statements, DESCRIBE, and transactions.
+
+Reference parity: execution/CreateViewTask / DropViewTask /
+PrepareTask / DeallocateTask, sql/rewrite/DescribeInputRewrite /
+DescribeOutputRewrite, transaction/InMemoryTransactionManager.
+"""
+
+import pytest
+
+from trino_tpu.exec import QueryError
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    return LocalQueryRunner()
+
+
+def test_create_select_drop_view(runner):
+    runner.execute("CREATE VIEW memory.default.v AS "
+                   "SELECT n_name, n_regionkey FROM tpch.tiny.nation "
+                   "WHERE n_nationkey < 5")
+    got = runner.execute(
+        "SELECT n_name FROM memory.default.v ORDER BY n_name").rows
+    assert got == [['ALGERIA'], ['ARGENTINA'], ['BRAZIL'], ['CANADA'],
+                   ['EGYPT']]
+    # views join with tables
+    got = runner.execute(
+        "SELECT count(*) FROM memory.default.v v "
+        "JOIN tpch.tiny.region r ON v.n_regionkey = r.r_regionkey").rows
+    assert got == [[5]]
+    sql = runner.execute(
+        "SHOW CREATE VIEW memory.default.v").rows[0][0]
+    assert sql.startswith("CREATE VIEW")
+    runner.execute("DROP VIEW memory.default.v")
+    with pytest.raises(QueryError):
+        runner.execute("SELECT * FROM memory.default.v")
+
+
+def test_create_or_replace_view(runner):
+    runner.execute("CREATE VIEW memory.default.v2 AS SELECT 1 AS x")
+    with pytest.raises(QueryError):
+        runner.execute(
+            "CREATE VIEW memory.default.v2 AS SELECT 2 AS x")
+    runner.execute(
+        "CREATE OR REPLACE VIEW memory.default.v2 AS SELECT 2 AS x")
+    assert runner.execute(
+        "SELECT x FROM memory.default.v2").rows == [[2]]
+
+
+def test_drop_view_if_exists(runner):
+    runner.execute("DROP VIEW IF EXISTS memory.default.nope")
+    with pytest.raises(QueryError):
+        runner.execute("DROP VIEW memory.default.nope")
+
+
+def test_prepare_execute_deallocate(runner):
+    runner.execute("PREPARE q FROM SELECT n_name FROM "
+                   "tpch.tiny.nation WHERE n_nationkey = ?")
+    assert runner.execute("EXECUTE q USING 3").rows == [['CANADA']]
+    assert runner.execute("EXECUTE q USING 0").rows == [['ALGERIA']]
+    out = runner.execute("DESCRIBE OUTPUT q").rows
+    assert out == [['n_name', 'varchar(25)']]
+    inp = runner.execute("DESCRIBE INPUT q").rows
+    assert inp == [[0, 'unknown']]
+    runner.execute("DEALLOCATE PREPARE q")
+    with pytest.raises(QueryError):
+        runner.execute("EXECUTE q USING 1")
+
+
+def test_execute_param_arity(runner):
+    runner.execute("PREPARE p2 FROM SELECT ? + ?")
+    assert runner.execute("EXECUTE p2 USING 1, 2").rows == [[3]]
+    with pytest.raises(QueryError):
+        runner.execute("EXECUTE p2 USING 1")
+
+
+def test_describe_table(runner):
+    rows = runner.execute("DESCRIBE tpch.tiny.region").rows
+    assert [r[0] for r in rows] == ["r_regionkey", "r_name",
+                                    "r_comment"]
+
+
+def test_show_create_table(runner):
+    sql = runner.execute(
+        "SHOW CREATE TABLE tpch.tiny.region").rows[0][0]
+    assert "r_regionkey" in sql and sql.startswith("CREATE TABLE")
+
+
+def test_transaction_rollback_commit(runner):
+    runner.execute("CREATE TABLE memory.default.tx (x bigint)")
+    runner.execute("INSERT INTO memory.default.tx VALUES (1)")
+    runner.execute("START TRANSACTION")
+    runner.execute("INSERT INTO memory.default.tx VALUES (2)")
+    runner.execute("DELETE FROM memory.default.tx WHERE x = 1")
+    assert runner.execute(
+        "SELECT x FROM memory.default.tx").rows == [[2]]
+    runner.execute("ROLLBACK")
+    assert runner.execute(
+        "SELECT x FROM memory.default.tx").rows == [[1]]
+    runner.execute("START TRANSACTION")
+    runner.execute("INSERT INTO memory.default.tx VALUES (9)")
+    runner.execute("COMMIT")
+    assert sorted(runner.execute(
+        "SELECT x FROM memory.default.tx").rows) == [[1], [9]]
+
+
+def test_transaction_ddl_rollback(runner):
+    runner.execute("START TRANSACTION")
+    runner.execute("CREATE TABLE memory.default.ephemeral (x bigint)")
+    runner.execute("ROLLBACK")
+    with pytest.raises(QueryError):
+        runner.execute("SELECT * FROM memory.default.ephemeral")
+
+
+def test_transaction_errors(runner):
+    with pytest.raises(QueryError):
+        runner.execute("COMMIT")
+    with pytest.raises(QueryError):
+        runner.execute("ROLLBACK")
+    runner.execute("START TRANSACTION")
+    with pytest.raises(QueryError):
+        runner.execute("START TRANSACTION")
+    runner.execute("COMMIT")

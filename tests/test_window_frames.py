@@ -1,0 +1,117 @@
+"""Explicit window frames: ROWS / RANGE / GROUPS BETWEEN bounds.
+
+Reference parity: operator/window/FrameInfo.java + WindowPartition
+frame machinery + AggregateWindowFunction; oracle values computed by
+hand per the SQL standard.
+"""
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+BASE = ("FROM (VALUES (1, 10), (2, 20), (3, 30), (4, 40), (5, 50)) "
+        "AS t(k, v)")
+
+
+def test_rows_preceding_following(runner):
+    got = q(runner,
+            "SELECT k, sum(v) OVER (ORDER BY k ROWS BETWEEN 1 "
+            f"PRECEDING AND 1 FOLLOWING) {BASE} ORDER BY k")
+    assert got == [[1, 30], [2, 60], [3, 90], [4, 120], [5, 90]]
+
+
+def test_rows_current_and_following(runner):
+    got = q(runner,
+            "SELECT k, sum(v) OVER (ORDER BY k ROWS BETWEEN CURRENT "
+            f"ROW AND UNBOUNDED FOLLOWING) {BASE} ORDER BY k")
+    assert got == [[1, 150], [2, 140], [3, 120], [4, 90], [5, 50]]
+
+
+def test_rows_moving_avg_min_max(runner):
+    got = q(runner,
+            "SELECT k, avg(v) OVER (ORDER BY k ROWS BETWEEN 2 "
+            "PRECEDING AND CURRENT ROW), "
+            "min(v) OVER (ORDER BY k ROWS BETWEEN 1 PRECEDING AND "
+            "1 FOLLOWING), "
+            "max(v) OVER (ORDER BY k ROWS BETWEEN 1 PRECEDING AND "
+            f"1 FOLLOWING) {BASE} ORDER BY k")
+    assert got == [
+        [1, 10.0, 10, 20], [2, 15.0, 10, 30], [3, 20.0, 20, 40],
+        [4, 30.0, 30, 50], [5, 40.0, 40, 50]]
+
+
+def test_rows_count_empty_frame(runner):
+    got = q(runner,
+            "SELECT k, count(v) OVER (ORDER BY k ROWS BETWEEN 3 "
+            "FOLLOWING AND 4 FOLLOWING), "
+            "sum(v) OVER (ORDER BY k ROWS BETWEEN 3 FOLLOWING AND "
+            f"4 FOLLOWING) {BASE} ORDER BY k")
+    assert got == [[1, 2, 90], [2, 1, 50], [3, 0, None], [4, 0, None],
+                   [5, 0, None]]
+
+
+def test_range_value_offsets(runner):
+    got = q(runner,
+            "SELECT k, sum(v) OVER (ORDER BY k RANGE BETWEEN 2 "
+            f"PRECEDING AND CURRENT ROW) {BASE} ORDER BY k")
+    assert got == [[1, 10], [2, 30], [3, 60], [4, 90], [5, 120]]
+
+
+def test_range_peers_included(runner):
+    # ties: RANGE CURRENT ROW spans the whole peer group
+    got = q(runner,
+            "SELECT v, sum(v) OVER (ORDER BY g RANGE BETWEEN "
+            "UNBOUNDED PRECEDING AND CURRENT ROW) FROM (VALUES "
+            "(1, 10), (1, 20), (2, 30)) AS t(g, v) ORDER BY v")
+    assert got == [[10, 30], [20, 30], [30, 60]]
+
+
+def test_groups_frames(runner):
+    got = q(runner,
+            "SELECT g, v, sum(v) OVER (ORDER BY g GROUPS BETWEEN 1 "
+            "PRECEDING AND CURRENT ROW) FROM (VALUES "
+            "(1, 10), (1, 20), (2, 30), (3, 40)) AS t(g, v) "
+            "ORDER BY g, v")
+    assert got == [[1, 10, 30], [1, 20, 30], [2, 30, 60],
+                   [3, 40, 70]]
+
+
+def test_first_last_nth_with_frames(runner):
+    got = q(runner,
+            "SELECT k, first_value(v) OVER (ORDER BY k ROWS BETWEEN "
+            "1 PRECEDING AND 1 FOLLOWING), "
+            "last_value(v) OVER (ORDER BY k ROWS BETWEEN 1 PRECEDING "
+            "AND 1 FOLLOWING), "
+            "nth_value(v, 2) OVER (ORDER BY k ROWS BETWEEN 1 "
+            f"PRECEDING AND 1 FOLLOWING) {BASE} ORDER BY k")
+    assert got == [[1, 10, 20, 20], [2, 10, 30, 20], [3, 20, 40, 30],
+                   [4, 30, 50, 40], [5, 40, 50, 50]]
+
+
+def test_frames_with_partitions(runner):
+    got = q(runner,
+            "SELECT p, k, sum(v) OVER (PARTITION BY p ORDER BY k "
+            "ROWS BETWEEN 1 PRECEDING AND CURRENT ROW) FROM (VALUES "
+            "('a', 1, 10), ('a', 2, 20), ('b', 1, 5), ('b', 2, 7)) "
+            "AS t(p, k, v) ORDER BY p, k")
+    assert got == [['a', 1, 10], ['a', 2, 30], ['b', 1, 5],
+                   ['b', 2, 12]]
+
+
+def test_frames_with_nulls(runner):
+    got = q(runner,
+            "SELECT k, sum(v) OVER (ORDER BY k ROWS BETWEEN 1 "
+            "PRECEDING AND CURRENT ROW) FROM (VALUES (1, 10), "
+            "(2, CAST(NULL AS bigint)), (3, 30)) AS t(k, v) "
+            "ORDER BY k")
+    assert got == [[1, 10], [2, 10], [3, 30]]

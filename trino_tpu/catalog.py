@@ -10,6 +10,7 @@ redesign: a connector's read path produces columnar ``Batch``es per split
 
 from __future__ import annotations
 
+import dataclasses
 from dataclasses import dataclass, field
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -43,13 +44,25 @@ class TableMetadata:
 
 
 @dataclass(frozen=True)
+class ViewDefinition:
+    """Engine view object (reference: metadata/ViewDefinition.java):
+    the parsed query plus the original SQL text for SHOW CREATE VIEW."""
+    query: object            # sql.ast.Query
+    sql: str = ""
+
+
+@dataclass(frozen=True)
 class TableHandle:
     """Engine-side handle: catalog + connector's table identity
     (reference: metadata/TableHandle.java wrapping
-    ConnectorTableHandle)."""
+    ConnectorTableHandle). ``constraint``/``limit`` carry accepted
+    pushdowns (applyFilter/applyLimit results baked into the handle,
+    like the reference's connector-specific handle evolution)."""
     catalog: str
     schema: str
     table: str
+    constraint: Optional[object] = None    # predicate.TupleDomain
+    limit: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -96,6 +109,20 @@ class Connector:
     def table_row_count(self, handle: TableHandle) -> Optional[float]:
         return None
 
+    # --- pushdown hooks (ConnectorMetadata.applyFilter/applyLimit) -------
+    def apply_filter(self, handle: TableHandle, constraint):
+        """Offer a TupleDomain over connector column names. Return
+        (new_handle, fully_enforced) to accept, or None to decline.
+        fully_enforced=True lets the engine drop the translated
+        conjuncts entirely — only safe when read_split enforces the
+        handle's constraint (predicate.filter_batch_host)."""
+        return None
+
+    def apply_limit(self, handle: TableHandle, limit: int):
+        """Return a new handle that will produce at most ``limit`` rows
+        per split (engine keeps its Limit node), or None."""
+        return None
+
     # --- data out (spi/connector/ConnectorPageSink.java) -----------------
     def create_table(self, metadata: TableMetadata) -> None:
         raise NotImplementedError(f"{self.name}: CREATE TABLE not supported")
@@ -106,12 +133,70 @@ class Connector:
     def insert(self, schema: str, table: str, batch: Batch) -> int:
         raise NotImplementedError(f"{self.name}: INSERT not supported")
 
+    # --- procedures (spi/procedure/Procedure.java) -----------------------
+    def call_procedure(self, schema: str, name: str, args: list):
+        raise KeyError(
+            f"Procedure '{self.name}.{schema}.{name}' not registered")
+
+    # --- transactions (spi/transaction/ConnectorTransactionHandle) -------
+    def snapshot_state(self):
+        """Opaque copy-on-begin state for the engine transaction manager
+        (None = connector is read-only / not transactional)."""
+        return None
+
+    def restore_state(self, state) -> None:
+        raise NotImplementedError(f"{self.name}: not transactional")
+
+
+def accept_filter_pushdown(handle: TableHandle, constraint):
+    """Shared applyFilter acceptance: intersect into the handle; the
+    connector's read_split MUST then enforce handle.constraint."""
+    merged = constraint if handle.constraint is None else \
+        handle.constraint.intersect(constraint)
+    return dataclasses.replace(handle, constraint=merged), True
+
+
+def accept_limit_pushdown(handle: TableHandle, limit: int):
+    """Shared applyLimit acceptance: keep the smaller limit; None when
+    the handle already guarantees no more rows."""
+    if handle.limit is not None and handle.limit <= limit:
+        return None
+    return dataclasses.replace(handle, limit=limit)
+
 
 class CatalogManager:
-    """metadata/CatalogManager.java — name → Connector registry."""
+    """metadata/CatalogManager.java — name → Connector registry, plus
+    the engine-side view store (reference: MetadataManager view
+    routing; views here are engine objects rather than per-connector
+    since every connector would store the same SQL text)."""
 
-    def __init__(self):
+    def __init__(self, access_control=None):
         self._catalogs: Dict[str, Connector] = {}
+        self._views: Dict[Tuple[str, str, str], "ViewDefinition"] = {}
+        # AccessControl SPI consulted by the planner/runner (None =
+        # allow all; security/AccessControlManager.java)
+        self.access_control = access_control
+
+    # --- views -----------------------------------------------------------
+    def create_view(self, catalog: str, schema: str, name: str,
+                    view: "ViewDefinition",
+                    replace: bool = False) -> None:
+        key = (catalog, schema, name)
+        if key in self._views and not replace:
+            raise KeyError(
+                f"View '{catalog}.{schema}.{name}' already exists")
+        self._views[key] = view
+
+    def drop_view(self, catalog: str, schema: str, name: str) -> bool:
+        return self._views.pop((catalog, schema, name), None) is not None
+
+    def get_view(self, catalog: str, schema: str,
+                 name: str) -> Optional["ViewDefinition"]:
+        return self._views.get((catalog, schema, name))
+
+    def list_views(self, catalog: str, schema: str) -> List[str]:
+        return sorted(n for (c, s, n) in self._views
+                      if c == catalog and s == schema)
 
     def register(self, name: str, connector: Connector) -> None:
         self._catalogs[name] = connector

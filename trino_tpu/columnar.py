@@ -20,7 +20,7 @@ and spi/block/* (70 files). Redesigned for XLA rather than translated:
 from __future__ import annotations
 
 from dataclasses import dataclass, replace
-from typing import Dict, List, Optional, Sequence, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -125,6 +125,14 @@ class Column:
     ``elements`` holds every element value (its own Column, possibly
     longer than the row capacity). Row gathers move only the
     offset/length lanes; ``elements`` is shared untouched.
+
+    MAP columns (spi/block/MapBlock.java): same offsets/length lanes;
+    ``elements`` is the flat KEY column and ``elements2`` the flat VALUE
+    column, entry-aligned (key i pairs with value i).
+
+    ROW columns (spi/block/RowBlock.java): ``children`` is one
+    row-aligned Column per field; ``data`` is a dummy int8 lane that
+    carries the capacity.
     """
 
     type: Type
@@ -133,6 +141,8 @@ class Column:
     dictionary: Optional[StringDictionary] = None
     data2: Optional[ArrayLike] = None
     elements: Optional["Column"] = None
+    elements2: Optional["Column"] = None
+    children: Optional[Tuple["Column", ...]] = None
 
     def __post_init__(self):
         if is_string(self.type) and self.dictionary is None:
@@ -165,8 +175,12 @@ class Column:
         data2 = (None if self.data2 is None
                  else jnp.take(jnp.asarray(self.data2), indices, axis=0,
                                mode="clip"))
+        children = (None if self.children is None
+                    else tuple(c.gather(indices, fill_invalid)
+                               for c in self.children))
         # elements are row-independent (offsets were gathered) — shared
-        return replace(self, data=data, valid=valid, data2=data2)
+        return replace(self, data=data, valid=valid, data2=data2,
+                       children=children)
 
     def valid_mask(self, n: Optional[int] = None) -> jax.Array:
         cap = self.capacity if n is None else n
@@ -230,6 +244,44 @@ def _to_lane(values, typ: Type):
 
 def column_from_pylist(values: Sequence, typ: Type) -> Column:
     """Build a host Column from python values (tests / VALUES literals)."""
+    from .types import ArrayType, MapType, RowType
+    if isinstance(typ, ArrayType):
+        valid = np.asarray([v is not None for v in values], dtype=bool)
+        lens = np.asarray([len(v) if v is not None else 0
+                           for v in values], dtype=np.int64)
+        offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+        flat: List = []
+        for v in values:
+            if v is not None:
+                flat.extend(v)
+        elements = column_from_pylist(flat or [None], typ.element)
+        return Column(typ, offs, None if valid.all() else valid, None,
+                      lens, elements)
+    if isinstance(typ, MapType):
+        valid = np.asarray([v is not None for v in values], dtype=bool)
+        lens = np.asarray([len(v) if v is not None else 0
+                           for v in values], dtype=np.int64)
+        offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+        ks: List = []
+        vs: List = []
+        for v in values:
+            if v is not None:
+                for k, val in v.items():
+                    ks.append(k)
+                    vs.append(val)
+        keys = column_from_pylist(ks or [None], typ.key)
+        vals = column_from_pylist(vs or [None], typ.value)
+        return Column(typ, offs, None if valid.all() else valid, None,
+                      lens, keys, vals)
+    if isinstance(typ, RowType):
+        valid = np.asarray([v is not None for v in values], dtype=bool)
+        kids = []
+        for i, (_, ft) in enumerate(typ.fields):
+            kids.append(column_from_pylist(
+                [(v[i] if v is not None else None) for v in values], ft))
+        return Column(typ, np.zeros(len(values), dtype=np.int8),
+                      None if valid.all() else valid,
+                      children=tuple(kids))
     if is_string(typ):
         dictionary, codes = StringDictionary.from_strings(
             [v for v in values])
@@ -345,6 +397,27 @@ class Batch:
                 lens = np.asarray(c.data2)[:n]
                 col = [(epy[int(data[i]): int(data[i]) + int(lens[i])]
                         if valid[i] else None) for i in range(n)]
+            elif t.name.startswith("map("):
+                k, v = c.elements, c.elements2
+                ecap = int(np.asarray(k.data).shape[0])
+                kpy = [r[0] for r in Batch({"k": k}, ecap).to_pylist()]
+                vpy = [r[0] for r in Batch({"v": v}, ecap).to_pylist()]
+                lens = np.asarray(c.data2)[:n]
+                col = []
+                for i in range(n):
+                    if not valid[i]:
+                        col.append(None)
+                        continue
+                    s, ln = int(data[i]), int(lens[i])
+                    col.append(dict(zip(kpy[s:s + ln], vpy[s:s + ln])))
+            elif t.name.startswith("row("):
+                kids = [
+                    [r[0] for r in
+                     Batch({"f": ch}, min(n, ch.capacity)).to_pylist()]
+                    for ch in c.children]
+                col = [(list(vals) if valid[i] else None)
+                       for i, vals in enumerate(zip(*kids))][:n] \
+                    if kids else [[] for _ in range(n)]
             elif t.name == "boolean":
                 col = [bool(data[i]) if valid[i] else None for i in range(n)]
             elif t.name in ("real", "double"):
@@ -409,7 +482,10 @@ def _pad(col: Column, cap: int) -> Column:
     data2 = None if col.data2 is None else np.concatenate(
         [np.asarray(col.data2),
          np.zeros(pad, dtype=np.asarray(col.data2).dtype)])
-    return replace(col, data=data, valid=valid, data2=data2)
+    children = (None if col.children is None
+                else tuple(_pad(c, cap) for c in col.children))
+    return replace(col, data=data, valid=valid, data2=data2,
+                   children=children)
 
 
 def pad_batch(batch: Batch, cap: int) -> Batch:
@@ -437,13 +513,15 @@ def empty_batch(schema: Dict[str, Type], capacity: int = 8) -> Batch:
 # compiled program embeds dictionary-derived lookup tables).
 
 def _column_flatten(c: Column):
-    return (c.data, c.valid, c.data2, c.elements), (c.type, c.dictionary)
+    return ((c.data, c.valid, c.data2, c.elements, c.elements2,
+             c.children), (c.type, c.dictionary))
 
 
-def _column_unflatten(aux, children):
-    data, valid, data2, elements = children
+def _column_unflatten(aux, kids):
+    data, valid, data2, elements, elements2, children = kids
     typ, dictionary = aux
-    return Column(typ, data, valid, dictionary, data2, elements)
+    return Column(typ, data, valid, dictionary, data2, elements,
+                  elements2, children)
 
 
 def _batch_flatten(b: Batch):
@@ -473,6 +551,12 @@ def concat_batches(batches: Sequence[Batch]) -> Batch:
     for name in names:
         parts = [b.column(name) for b in batches]
         typ = parts[0].type
+        if parts[0].elements is not None or parts[0].children is not None:
+            from .exec.complex import concat_columns_host
+            cols[name] = concat_columns_host(
+                parts, [b.num_rows_host() for b in batches],
+                capacity_for(total))
+            continue
         datas, valids = [], []
         if is_string(typ):
             merged = parts[0].dictionary

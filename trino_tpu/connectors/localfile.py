@@ -1,0 +1,219 @@
+"""Local-file connector: a directory of parquet/CSV/JSON files as
+tables.
+
+Reference parity: plugin/trino-local-file (1.9k loc) generalized with
+the record decoders of lib/trino-record-decoder (JSON/CSV row decoders)
+and the parquet binding the hive plugin provides in the reference.
+Each file (or basename) is a table; parquet files split per ROW GROUP
+so scans parallelize like the reference's split model."""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..catalog import (ColumnMetadata, Connector, Split, TableHandle,
+                       TableMetadata)
+from ..columnar import Batch, batch_from_pylist
+from ..types import (BIGINT, BOOLEAN, DOUBLE, Type, VARCHAR)
+
+_EXTS = (".parquet", ".csv", ".tsv", ".json", ".ndjson")
+
+
+class LocalFileConnector(Connector):
+    name = "localfile"
+
+    def __init__(self, root: str):
+        self.root = root
+
+    # --- metadata --------------------------------------------------------
+    def list_schemas(self) -> List[str]:
+        return ["default"]
+
+    def _path_of(self, table: str) -> Optional[str]:
+        for fn in sorted(os.listdir(self.root)):
+            base, ext = os.path.splitext(fn)
+            if ext.lower() in _EXTS and base.lower() == table:
+                return os.path.join(self.root, fn)
+        return None
+
+    def list_tables(self, schema: str) -> List[str]:
+        out = []
+        if schema != "default" or not os.path.isdir(self.root):
+            return out
+        for fn in sorted(os.listdir(self.root)):
+            base, ext = os.path.splitext(fn)
+            if ext.lower() in _EXTS:
+                out.append(base.lower())
+        return out
+
+    def get_table_metadata(self, schema, table) -> Optional[TableMetadata]:
+        path = self._path_of(table)
+        if path is None:
+            return None
+        schema_map = self._schema_for(path)
+        return TableMetadata(schema, table, tuple(
+            ColumnMetadata(n, t) for n, t in schema_map.items()))
+
+    def _schema_for(self, path: str) -> Dict[str, Type]:
+        ext = os.path.splitext(path)[1].lower()
+        if ext == ".parquet":
+            from ..formats.parquet import schema_of
+            return schema_of(path)
+        if ext in (".csv", ".tsv"):
+            rows = self._csv_rows(path, limit=100)
+            return _infer_schema(rows)
+        rows = self._json_rows(path, limit=100)
+        return _infer_schema(rows)
+
+    # --- rows ------------------------------------------------------------
+    def _csv_rows(self, path: str,
+                  limit: Optional[int] = None) -> List[dict]:
+        delim = "\t" if path.lower().endswith(".tsv") else ","
+        out = []
+        with open(path, newline="") as f:
+            for i, row in enumerate(csv.DictReader(f, delimiter=delim)):
+                if limit is not None and i >= limit:
+                    break
+                out.append({k.lower(): v for k, v in row.items()})
+        return out
+
+    def _json_rows(self, path: str,
+                   limit: Optional[int] = None) -> List[dict]:
+        out = []
+        with open(path) as f:
+            for i, line in enumerate(f):
+                if limit is not None and i >= limit:
+                    break
+                line = line.strip()
+                if line:
+                    out.append({k.lower(): v
+                                for k, v in json.loads(line).items()})
+        return out
+
+    # --- splits ----------------------------------------------------------
+    def get_splits(self, handle: TableHandle,
+                   desired_parallelism: int = 1) -> List[Split]:
+        path = self._path_of(handle.table)
+        if path and path.lower().endswith(".parquet"):
+            from ..formats.parquet import num_row_groups
+            n = max(1, num_row_groups(path))
+            return [Split(handle, i, n) for i in range(n)]
+        return [Split(handle, 0, 1)]
+
+    # --- data in ---------------------------------------------------------
+    def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
+        path = self._path_of(split.handle.table)
+        if path is None:
+            raise KeyError(f"table {split.handle.table} vanished")
+        ext = os.path.splitext(path)[1].lower()
+        need = list(columns)
+        if split.handle.constraint is not None:
+            # constraint columns must be materialized to enforce the
+            # accepted pushdown even when projection-pruned
+            for c, _ in split.handle.constraint.domains:
+                if c not in need:
+                    need.append(c)
+        if ext == ".parquet":
+            from ..formats.parquet import read_parquet
+            batch = read_parquet(
+                path, columns=need,
+                row_group=split.part if split.part_count > 1 else None)
+        else:
+            rows = (self._csv_rows(path) if ext in (".csv", ".tsv")
+                    else self._json_rows(path))
+            schema = self._schema_for(path)
+            data = {}
+            for name, t in schema.items():
+                data[name] = [_coerce(r.get(name), t) for r in rows]
+            batch = batch_from_pylist(data, schema)
+        if split.handle.constraint is not None \
+                or split.handle.limit is not None:
+            from ..predicate import filter_batch_host
+            batch = filter_batch_host(batch, split.handle.constraint,
+                                      split.handle.limit)
+        return batch.select_columns(list(columns))
+
+    def apply_filter(self, handle: TableHandle, constraint):
+        from ..catalog import accept_filter_pushdown
+        return accept_filter_pushdown(handle, constraint)
+
+    def apply_limit(self, handle: TableHandle, limit: int):
+        from ..catalog import accept_limit_pushdown
+        return accept_limit_pushdown(handle, limit)
+
+    def table_row_count(self, handle: TableHandle) -> Optional[float]:
+        path = self._path_of(handle.table)
+        if path and path.lower().endswith(".parquet"):
+            from ..formats.parquet import read_metadata
+            return float(read_metadata(path).num_rows)
+        return None
+
+
+def _infer_schema(rows: List[dict]) -> Dict[str, Type]:
+    """Type inference over sampled rows (record-decoder style: every
+    CSV value is text; JSON carries bool/number natively)."""
+    if not rows:
+        return {}
+    schema: Dict[str, Type] = {}
+    for key in rows[0]:
+        vals = [r.get(key) for r in rows if r.get(key) not in (None, "")]
+        schema[key] = _infer_type(vals)
+    return schema
+
+
+def _infer_type(vals: list) -> Type:
+    if not vals:
+        return VARCHAR
+    if all(isinstance(v, bool) for v in vals):
+        return BOOLEAN
+    if all(isinstance(v, int) and not isinstance(v, bool)
+           for v in vals):
+        return BIGINT
+    if all(isinstance(v, (int, float)) and not isinstance(v, bool)
+           for v in vals):
+        return DOUBLE
+    if all(isinstance(v, str) for v in vals):
+        if all(_is_int(v) for v in vals):
+            return BIGINT
+        if all(_is_float(v) for v in vals):
+            return DOUBLE
+        low = {v.lower() for v in vals}
+        if low <= {"true", "false"}:
+            return BOOLEAN
+    return VARCHAR
+
+
+def _is_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _is_float(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except ValueError:
+        return False
+
+
+def _coerce(v, t: Type):
+    if v is None or v == "":
+        return None
+    if t is BIGINT:
+        return int(v)
+    if t is DOUBLE:
+        return float(v)
+    if t is BOOLEAN:
+        if isinstance(v, bool):
+            return v
+        return str(v).lower() == "true"
+    return str(v)

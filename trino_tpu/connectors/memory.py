@@ -68,13 +68,39 @@ class MemoryConnector(Connector):
                 {c.name: c.type for c in meta.columns
                  if c.name in set(columns)})
         whole = concat_batches(batches)
+        if split.handle.constraint is not None \
+                or split.handle.limit is not None:
+            from ..predicate import filter_batch_host
+            whole = filter_batch_host(whole, split.handle.constraint,
+                                      split.handle.limit)
         return whole.select_columns(list(columns))
+
+    # --- pushdown (ConnectorMetadata.applyFilter/applyLimit) -------------
+    def apply_filter(self, handle: TableHandle, constraint):
+        from ..catalog import accept_filter_pushdown
+        return accept_filter_pushdown(handle, constraint)
+
+    def apply_limit(self, handle: TableHandle, limit: int):
+        from ..catalog import accept_limit_pushdown
+        return accept_limit_pushdown(handle, limit)
 
     def table_row_count(self, handle: TableHandle) -> Optional[float]:
         entry = self._tables.get((handle.schema, handle.table))
         if entry is None:
             return None
         return float(sum(b.num_rows_host() for b in entry[1]))
+
+    # --- transactions: snapshot-on-begin, restore-on-rollback ------------
+    def snapshot_state(self):
+        return ({k: (meta, list(batches))
+                 for k, (meta, batches) in self._tables.items()},
+                set(self._schemas))
+
+    def restore_state(self, state) -> None:
+        tables, schemas = state
+        self._tables = {k: (meta, list(batches))
+                        for k, (meta, batches) in tables.items()}
+        self._schemas = set(schemas)
 
 
 class BlackholeConnector(Connector):

@@ -1,0 +1,112 @@
+"""System catalog: runtime introspection tables + procedures.
+
+Reference parity: connector/system/ (QuerySystemTable.java,
+NodeSystemTable.java, KillQueryProcedure.java — 25+ files). The
+connector is constructed over a provider object (the Coordinator or a
+QueryTracker) exposing ``query_infos()`` / ``node_infos()`` /
+``kill_query(id)``; in a plain LocalQueryRunner the provider is a stub
+with no queries."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Sequence
+
+from ..catalog import (ColumnMetadata, Connector, Split, TableHandle,
+                       TableMetadata)
+from ..columnar import Batch, batch_from_pylist
+from ..types import BIGINT, BOOLEAN, VARCHAR
+
+_RUNTIME_TABLES = {
+    "queries": (
+        ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
+        ("source", VARCHAR), ("query", VARCHAR),
+        ("queued_time_ms", BIGINT), ("analysis_time_ms", BIGINT),
+        ("created", VARCHAR),
+    ),
+    "nodes": (
+        ("node_id", VARCHAR), ("http_uri", VARCHAR),
+        ("node_version", VARCHAR), ("coordinator", BOOLEAN),
+        ("state", VARCHAR),
+    ),
+    "resource_groups": (
+        ("name", VARCHAR), ("running", BIGINT), ("queued", BIGINT),
+        ("hard_concurrency_limit", BIGINT), ("max_queued", BIGINT),
+    ),
+}
+
+
+class SystemProvider:
+    """Provider SPI; the Coordinator implements these."""
+
+    def query_infos(self) -> List[dict]:
+        return []
+
+    def node_infos(self) -> List[dict]:
+        return []
+
+    def resource_group_infos(self) -> List[dict]:
+        return []
+
+    def kill_query(self, query_id: str) -> bool:
+        raise KeyError(f"query not found: {query_id}")
+
+
+class SystemConnector(Connector):
+    name = "system"
+
+    def __init__(self, provider: Optional[SystemProvider] = None):
+        self.provider = provider or SystemProvider()
+
+    def list_schemas(self) -> List[str]:
+        return ["runtime"]
+
+    def list_tables(self, schema: str) -> List[str]:
+        if schema == "runtime":
+            return sorted(_RUNTIME_TABLES)
+        return []
+
+    def get_table_metadata(self, schema, table) -> Optional[TableMetadata]:
+        cols = _RUNTIME_TABLES.get(table) if schema == "runtime" else None
+        if cols is None:
+            return None
+        return TableMetadata(schema, table, tuple(
+            ColumnMetadata(n, t) for n, t in cols))
+
+    def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
+        table = split.handle.table
+        cols = _RUNTIME_TABLES[table]
+        if table == "queries":
+            rows = [
+                (i.get("queryId", ""), i.get("state", ""),
+                 i.get("user", ""), i.get("source", ""),
+                 i.get("query", ""), i.get("elapsedTimeMillis", 0),
+                 i.get("analysisTimeMillis", 0), i.get("created", ""))
+                for i in self.provider.query_infos()]
+        elif table == "nodes":
+            rows = [
+                (i.get("nodeId", ""), i.get("uri", ""),
+                 i.get("nodeVersion", ""), i.get("coordinator", False),
+                 i.get("state", "active"))
+                for i in self.provider.node_infos()]
+        else:
+            rows = [
+                (i.get("name", ""), i.get("running", 0),
+                 i.get("queued", 0), i.get("hardConcurrencyLimit", 0),
+                 i.get("maxQueued", 0))
+                for i in self.provider.resource_group_infos()]
+        names = [n for n, _ in cols]
+        data = {n: [r[i] for r in rows] for i, n in enumerate(names)}
+        return batch_from_pylist(data, dict(cols)).select_columns(
+            [c for c in columns])
+
+    # --- procedures (connector/system/KillQueryProcedure.java) -----------
+    def call_procedure(self, schema: str, name: str, args: list):
+        if schema == "runtime" and name == "kill_query":
+            if not args:
+                raise ValueError("kill_query(query_id) requires an id")
+            ok = self.provider.kill_query(str(args[0]))
+            if not ok:
+                raise KeyError(f"query not found: {args[0]}")
+            return
+        raise KeyError(f"Procedure '{schema}.{name}' not registered")

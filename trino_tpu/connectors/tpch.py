@@ -378,19 +378,44 @@ class TpchConnector(Connector):
     def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
         sf = SCHEMAS[split.handle.schema]
         table = split.handle.table
+        handle = split.handle
+        gen_cols = list(columns)
+        if handle.constraint is not None:
+            # generate constraint columns too, mask, then project
+            for c, _ in handle.constraint.domains:
+                if c not in gen_cols:
+                    gen_cols.append(c)
         if table == "region":
-            return self._region(columns)
-        if table == "nation":
-            return self._nation(columns)
-        if table == "lineitem":
-            units = table_rows("orders", sf)
+            out = self._region(gen_cols)
+        elif table == "nation":
+            out = self._nation(gen_cols)
         else:
-            units = table_rows(table, sf)
-        lo = split.part * units // split.part_count
-        hi = (split.part + 1) * units // split.part_count
-        idx = np.arange(lo + 1, hi + 1, dtype=np.int64)  # keys are 1-based
-        gen = getattr(self, f"_{table}")
-        return gen(idx, sf, columns)
+            if table == "lineitem":
+                units = table_rows("orders", sf)
+            else:
+                units = table_rows(table, sf)
+            lo = split.part * units // split.part_count
+            hi = (split.part + 1) * units // split.part_count
+            idx = np.arange(lo + 1, hi + 1, dtype=np.int64)  # 1-based
+            gen = getattr(self, f"_{table}")
+            out = gen(idx, sf, gen_cols)
+        if handle.constraint is not None or handle.limit is not None:
+            from ..predicate import filter_batch_host
+            out = filter_batch_host(out, handle.constraint,
+                                    handle.limit)
+            out = out.select_columns(list(columns))
+        return out
+
+    # --- pushdown (plugin/trino-tpch has no applyFilter in the
+    # reference; ours accepts domains because masking at generation
+    # time keeps host->HBM bytes down, the applyFilter contract) -------
+    def apply_filter(self, handle: TableHandle, constraint):
+        from ..catalog import accept_filter_pushdown
+        return accept_filter_pushdown(handle, constraint)
+
+    def apply_limit(self, handle: TableHandle, limit: int):
+        from ..catalog import accept_limit_pushdown
+        return accept_limit_pushdown(handle, limit)
 
     # --- per-table generators -------------------------------------------
     def _finish(self, cols: Dict[str, Column], n: int,

@@ -1,0 +1,1125 @@
+"""Complex-type (ARRAY / MAP / ROW) and lambda (higher-order) evaluation.
+
+Reference parity: operator/scalar/ArrayTransformFunction.java,
+ArrayFilterFunction, ReduceFunction, ZipWithFunction, MapFilterFunction,
+MapTransformKeys/ValuesFunction, MapFunctions, ArrayFunctions (SURVEY.md
+Appendix A.10), and the SpecialForm row/field machinery.
+
+TPU-first design note: the hot engine path (scan/filter/join/aggregate)
+is device-compiled; complex-type expressions are an auxiliary SQL surface
+whose per-row variable-length structure is hostile to static shapes, so
+they evaluate host-side in numpy over the same flat struct-of-arrays
+Column layout (offsets + lengths + flat element pools). Any chain-JIT
+attempt that traces into these functions raises a concretization error
+and the executor transparently re-runs the chain eagerly
+(exec/executor.py:144-155).
+
+Lambdas: a ``rex.Lambda`` carries synthetic parameter symbols; the body
+is evaluated by the ordinary vectorized evaluator over a Batch whose
+"rows" are the flat ELEMENTS of the canonicalized array — one eval for
+all rows' elements, never a per-row python loop (except ``reduce``,
+which is inherently sequential in its state and loops over element
+POSITIONS, still vectorized across rows).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import Batch, Column, StringDictionary
+from ..rex import Call, Lambda, input_names
+from ..types import (BIGINT, BOOLEAN, ArrayType, MapType, RowType, Type,
+                     VARCHAR, is_string)
+
+
+class EvalError(Exception):
+    # re-exported name; exec.expr defines the canonical class. Kept so
+    # this module can be imported standalone in tests.
+    pass
+
+
+def _err():
+    from .expr import EvalError as E
+    return E
+
+
+def _eval(e, batch):
+    from .expr import eval_expr
+    return eval_expr(e, batch)
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def _host_int(x) -> int:
+    """Host-sync an int; raises under jit tracing (triggering the
+    executor's eager fallback)."""
+    return int(x)
+
+
+def _valid_np(col: Column, n: int) -> np.ndarray:
+    if col.valid is None:
+        return np.ones(n, dtype=bool)
+    return _np(col.valid)[:n].astype(bool)
+
+
+def canonicalize(col: Column, cap: Optional[int] = None,
+                 valid_override: Optional[np.ndarray] = None) -> Column:
+    """Re-pack an ARRAY/MAP column so offsets are the cumsum of lengths
+    and the element pool contains exactly the live elements in row
+    order. Gathered/sliced columns share (and may overlap) their pools;
+    canonical form restores the owner[flat_idx] bijection every
+    element-wise kernel needs. ``valid_override`` additionally zeroes
+    rows an enclosing op has decided are NULL (so two columns packed
+    with the same override stay entry-aligned)."""
+    cap = col.capacity if cap is None else cap
+    offs = _np(col.data)[:cap].astype(np.int64)
+    lens = _np(col.data2)[:cap].astype(np.int64)
+    valid = _valid_np(col, cap)
+    if valid_override is not None:
+        valid = valid & valid_override
+    lens = np.where(valid, lens, 0)
+    new_offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    total = _host_int(lens.sum())
+    # flat gather indices: for element j of row i -> offs[i] + j
+    owner = np.repeat(np.arange(cap, dtype=np.int64), lens)
+    j = (np.arange(total, dtype=np.int64)
+         - np.repeat(new_offs, lens))
+    src = offs[owner] + j
+    elements = _take_flat(col.elements, src)
+    elements2 = (None if col.elements2 is None
+                 else _take_flat(col.elements2, src))
+    return Column(col.type, new_offs,
+                  None if valid.all() else valid, None, lens,
+                  elements, elements2)
+
+
+def _take_flat(el: Column, idx: np.ndarray) -> Column:
+    """Gather a flat element pool by indices (host)."""
+    n = len(_np(el.data))
+    safe = np.clip(idx, 0, max(n - 1, 0))
+    data = _np(el.data)[safe] if n else np.zeros(0, _np(el.data).dtype)
+    valid = None if el.valid is None else _np(el.valid)[safe]
+    d2 = None if el.data2 is None else _np(el.data2)[safe]
+    elements = None
+    if el.elements is not None:
+        # nested arrays: offsets lane gathered, pool shared
+        elements = el.elements
+    e2 = el.elements2
+    children = (None if el.children is None
+                else tuple(_take_flat(c, idx) for c in el.children))
+    return Column(el.type, data, valid, el.dictionary, d2, elements, e2,
+                  children)
+
+
+def _owners(col: Column, cap: int) -> np.ndarray:
+    """owner[flat_idx] for a CANONICAL column."""
+    lens = np.where(_valid_np(col, cap),
+                    _np(col.data2)[:cap].astype(np.int64), 0)
+    return np.repeat(np.arange(cap, dtype=np.int64), lens)
+
+
+def _element_batch(params_cols: Dict[str, Column], body, outer: Batch,
+                   owner: np.ndarray) -> Batch:
+    """Batch over flat elements: lambda params -> element pools, free
+    outer references -> outer columns gathered by element owner."""
+    total = len(owner)
+    cols = dict(params_cols)
+    free = input_names(body) - set(params_cols)
+    for name in free:
+        if name in outer.columns:
+            cols[name] = outer.columns[name].gather(owner)
+    return Batch(cols, total)
+
+
+def _rebuild(arr_type: Type, canon: Column, new_elements: Column,
+             elements2: Optional[Column] = None) -> Column:
+    return Column(arr_type, canon.data, canon.valid, None, canon.data2,
+                  new_elements, elements2)
+
+
+# --------------------------------------------------------------------------
+# constructors / accessors
+# --------------------------------------------------------------------------
+
+def array_ctor_complex(e: Call, items, batch: Batch) -> Column:
+    """ARRAY[a, b, ...] where elements are themselves ARRAY/MAP/ROW
+    columns: pools are merged host-side; the flat pool is interleaved
+    row-major (row r's elements at flat positions r*k..r*k+k-1)."""
+    cap = batch.capacity
+    k = len(items)
+    first = items[0]
+    if first.children is not None:      # ROW elements
+        flat_children = []
+        for ci in range(len(first.children)):
+            parts = [it.children[ci] for it in items]
+            flat_children.append(_interleave_flat(parts, cap))
+        fvalid = _interleave_valids(items, cap)
+        flat = Column(first.type, np.zeros(cap * k, np.int8), fvalid,
+                      children=tuple(flat_children))
+    else:                               # ARRAY/MAP elements
+        canons = [canonicalize(it, cap) for it in items]
+        pools = [c.elements for c in canons]
+        pool = _concat_flat(pools)
+        bases = np.cumsum([0] + [len(_np(p.data)) for p in pools[:-1]])
+        offs = np.stack([bases[i] + _np(c.data)[:cap].astype(np.int64)
+                         for i, c in enumerate(canons)],
+                        axis=1).reshape(-1)
+        lens = np.stack([_np(c.data2)[:cap].astype(np.int64)
+                         for c in canons], axis=1).reshape(-1)
+        fvalid = _interleave_valids(items, cap)
+        pool2 = (None if canons[0].elements2 is None
+                 else _concat_flat([c.elements2 for c in canons]))
+        flat = Column(first.type, offs, fvalid, None, lens, pool, pool2)
+    start = np.arange(cap, dtype=np.int64) * k
+    length = np.full(cap, k, np.int64)
+    return Column(e.type, start, None, None, length, flat)
+
+
+def _interleave_flat(parts, cap):
+    """Row-interleave k row-aligned columns into one flat pool of
+    length cap*k."""
+    k = len(parts)
+    idx = np.arange(cap * k, dtype=np.int64) // k
+    gathered = [_take_flat(p, idx) for p in parts]
+    # select element (i % k) from gathered[i % k]
+    sel = np.arange(cap * k, dtype=np.int64) % k
+    out = gathered[0]
+    from dataclasses import replace as _rp
+    data = _np(out.data).copy()
+    valid = (None if all(g.valid is None for g in gathered)
+             else np.ones(cap * k, bool))
+    d2 = None if out.data2 is None else _np(out.data2).copy()
+    if is_string(out.type):
+        merged = gathered[0].dictionary
+        remaps = [np.arange(len(merged), dtype=np.int64)]
+        for g in gathered[1:]:
+            merged, _, ro = merged.merge(g.dictionary)
+            remaps.append(ro)
+        data = data.astype(np.int64)
+        for i, g in enumerate(gathered):
+            m = sel == i
+            data[m] = remaps[i][_np(g.data)[m].astype(np.int64)]
+        data = data.astype(np.int32)
+        for i, g in enumerate(gathered):
+            if valid is not None:
+                m = sel == i
+                valid[m] = (np.ones(m.sum(), bool) if g.valid is None
+                            else _np(g.valid)[m].astype(bool))
+        return Column(out.type, data, valid, merged)
+    for i, g in enumerate(gathered[1:], start=1):
+        m = sel == i
+        data[m] = _np(g.data)[m]
+        if d2 is not None and g.data2 is not None:
+            d2[m] = _np(g.data2)[m]
+    if valid is not None:
+        for i, g in enumerate(gathered):
+            m = sel == i
+            valid[m] = (np.ones(int(m.sum()), bool) if g.valid is None
+                        else _np(g.valid)[m].astype(bool))
+    return Column(out.type, data, valid, None, d2, out.elements,
+                  out.elements2, out.children)
+
+
+def _interleave_valids(items, cap):
+    k = len(items)
+    if all(it.valid is None for it in items):
+        return None
+    vl = [np.ones(cap, bool) if it.valid is None
+          else _np(it.valid)[:cap].astype(bool) for it in items]
+    return np.stack(vl, axis=1).reshape(-1)
+
+def _map_ctor(e: Call, batch: Batch) -> Column:
+    keys_arr = _eval(e.args[0], batch)
+    vals_arr = _eval(e.args[1], batch)
+    cap = batch.capacity
+    # rows where either side is NULL produce a NULL map; packing BOTH
+    # pools with the combined validity keeps them entry-aligned (a
+    # keys-valid/values-NULL row must not leave orphan key entries that
+    # shift every later row's value offsets)
+    both = _valid_np(keys_arr, cap) & _valid_np(vals_arr, cap)
+    k = canonicalize(keys_arr, cap, valid_override=both)
+    v = canonicalize(vals_arr, cap, valid_override=both)
+    kl = _np(k.data2)[:cap]
+    vl = _np(v.data2)[:cap]
+    n = batch.num_rows_host() if not isinstance(batch.num_rows, int) \
+        else batch.num_rows
+    live = np.arange(cap) < n
+    if np.any((kl != vl) & both & live):
+        raise _err()("map(): key and value arrays must have equal "
+                     "lengths")
+    valid = None if both.all() else both
+    return Column(e.type, k.data, valid, None, k.data2, k.elements,
+                  v.elements)
+
+
+def _row_ctor(e: Call, batch: Batch) -> Column:
+    items = tuple(_eval(a, batch) for a in e.args)
+    cap = batch.capacity
+    return Column(e.type, np.zeros(cap, dtype=np.int8), None,
+                  children=items)
+
+
+def _row_field(e: Call, batch: Batch) -> Column:
+    row = _eval(e.args[0], batch)
+    idx = int(e.args[1].value)
+    child = row.children[idx]
+    if row.valid is not None:
+        v = (_np(row.valid).astype(bool)
+             if child.valid is None
+             else (_np(child.valid).astype(bool)
+                   & _np(row.valid).astype(bool)))
+        from dataclasses import replace as _rp
+        child = _rp(child, valid=v)
+    return child
+
+
+def _map_element_at(e: Call, batch: Batch) -> Column:
+    """element_at(map, key) / m[key]: per-row key lookup, NULL when
+    absent. Vectorized: canonical owners + equality over the flat key
+    pool, last match wins (duplicate keys keep the later entry, matching
+    map_concat semantics)."""
+    m = _eval(e.args[0], batch)
+    probe = _eval(e.args[1], batch)
+    cap = batch.capacity
+    canon = canonicalize(m, cap)
+    owner = _owners(canon, cap)
+    keys, vals = canon.elements, canon.elements2
+    kdata = _np(keys.data)
+    total = len(owner)
+    pd = _np(probe.data)
+    if is_string(keys.type):
+        # align probe codes with the key pool's dictionary
+        merged, rk, rp = keys.dictionary.merge(probe.dictionary)
+        kcmp = rk[kdata[:total].astype(np.int64)] if total else \
+            np.zeros(0, np.int64)
+        pcmp = rp[pd.astype(np.int64)]
+    else:
+        kcmp = kdata[:total]
+        pcmp = pd
+    match = kcmp == pcmp[owner] if total else np.zeros(0, bool)
+    if keys.valid is not None:
+        match &= _np(keys.valid)[:total].astype(bool)
+    # last matching flat index per owner (scatter in ascending order)
+    found = np.full(cap, -1, dtype=np.int64)
+    mi = np.nonzero(match)[0]
+    found[owner[mi]] = mi
+    ok = found >= 0
+    out = _take_flat(vals, np.where(ok, found, 0))
+    valid = ok & _valid_np(m, cap) & _valid_np(probe, cap)
+    if out.valid is not None:
+        valid = valid & _np(out.valid).astype(bool)
+    from dataclasses import replace as _rp
+    return _rp(out, valid=valid)
+
+
+def _map_keys(e: Call, batch: Batch) -> Column:
+    m = _eval(e.args[0], batch)
+    return Column(e.type, m.data, m.valid, None, m.data2, m.elements)
+
+
+def _map_values(e: Call, batch: Batch) -> Column:
+    m = _eval(e.args[0], batch)
+    return Column(e.type, m.data, m.valid, None, m.data2, m.elements2)
+
+
+def _map_entries(e: Call, batch: Batch) -> Column:
+    m = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(m, cap)
+    total = len(_owners(canon, cap))
+    row_el = Column(e.type.element,
+                    np.zeros(total, dtype=np.int8), None,
+                    children=(canon.elements, canon.elements2))
+    return _rebuild(e.type, canon, row_el)
+
+
+def _map_concat(e: Call, batch: Batch) -> Column:
+    """map_concat(m1, m2, ...): union, later maps win on duplicate
+    keys."""
+    maps = [canonicalize(_eval(a, batch), batch.capacity)
+            for a in e.args]
+    cap = batch.capacity
+    # concat pools with a source-order tag, then keep the LAST
+    # occurrence of each (row, key)
+    owners, flats, srcs = [], [], []
+    for si, m in enumerate(maps):
+        ow = _owners(m, cap)
+        owners.append(ow)
+        flats.append(m)
+        srcs.append(np.full(len(ow), si, dtype=np.int64))
+    owner = np.concatenate(owners) if owners else np.zeros(0, np.int64)
+    src = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+    # global element index within its source pool
+    within = np.concatenate(
+        [np.arange(len(o), dtype=np.int64) for o in owners]) \
+        if owners else np.zeros(0, np.int64)
+    # comparable key lane across pools
+    keycols = [m.elements for m in maps]
+    if any(is_string(k.type) for k in keycols):
+        merged = keycols[0].dictionary
+        remaps = [None] * len(keycols)
+        remaps[0] = np.arange(len(merged), dtype=np.int64)
+        for i in range(1, len(keycols)):
+            merged, _, ro = merged.merge(keycols[i].dictionary)
+            remaps[i] = ro
+        klanes = [remaps[i][_np(k.data)[:len(owners[i])].astype(np.int64)]
+                  for i, k in enumerate(keycols)]
+    else:
+        klanes = [_np(k.data)[:len(owners[i])]
+                  for i, k in enumerate(keycols)]
+    key = np.concatenate(klanes) if klanes else np.zeros(0, np.int64)
+    # sort by (owner, key, src, within); keep last per (owner, key)
+    order = np.lexsort((within, src, key, owner))
+    so, sk = owner[order], key[order]
+    is_last = np.ones(len(order), dtype=bool)
+    if len(order) > 1:
+        is_last[:-1] = (so[1:] != so[:-1]) | (sk[1:] != sk[:-1])
+    # order[is_last] is already owner-major (lexsort primary key), so
+    # the gathered pool is row-major; entries come out key-sorted per
+    # row, which is fine — map entry order is not semantic
+    keep = order[is_last]
+    k_owner = owner[keep]
+    lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    # gather surviving entries: ONE vectorized _take_flat per source
+    # pool, then permute the concatenated result back into keep order
+    out_k = _gather_multi([m.elements for m in maps], src[keep],
+                          within[keep])
+    out_v = _gather_multi([m.elements2 for m in maps], src[keep],
+                          within[keep])
+    valids = [_valid_np(m, cap) for m in maps]
+    valid = valids[0]
+    for v in valids[1:]:
+        valid = valid & v
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  lens, out_k, out_v)
+
+
+def _gather_multi(pools, src: np.ndarray, within: np.ndarray) -> Column:
+    """Gather flat elements scattered across several source pools:
+    pools[src[i]][within[i]] for each output position i — one
+    vectorized _take_flat per pool plus a permutation, never a
+    per-element gather."""
+    if len(src) == 0:
+        return _take_flat(pools[0], np.zeros(0, np.int64))
+    order_by_src = np.argsort(src, kind="stable")
+    parts = []
+    for i, pool in enumerate(pools):
+        sel = within[src == i]
+        parts.append(_take_flat(pool, sel.astype(np.int64)))
+    cat = _concat_flat([p for p in parts])
+    inv = np.empty(len(src), dtype=np.int64)
+    inv[order_by_src] = np.arange(len(src), dtype=np.int64)
+    return _take_flat(cat, inv)
+
+
+def concat_columns_host(cols, counts, cap: int) -> Column:
+    """Concatenate the live prefixes of columns of ANY type host-side,
+    padding the row lanes to ``cap``. The pooled-column (ARRAY/MAP/ROW)
+    concat point for device_concat / concat_batches — pools merge with
+    rebased offsets."""
+    from ..columnar import _pad
+    typ = cols[0].type
+    if isinstance(typ, (ArrayType, MapType)):
+        canons = [canonicalize(c, n) for c, n in zip(cols, counts)]
+        pools = [c.elements for c in canons]
+        pool = _concat_flat(pools)
+        pool2 = None
+        if canons[0].elements2 is not None:
+            pool2 = _concat_flat([c.elements2 for c in canons])
+        bases = np.cumsum([0] + [len(_np(p.data)) for p in pools[:-1]])
+        offs = np.concatenate(
+            [b + _np(c.data)[:n].astype(np.int64)
+             for b, c, n in zip(bases, canons, counts)]) \
+            if counts else np.zeros(0, np.int64)
+        lens = np.concatenate(
+            [_np(c.data2)[:n].astype(np.int64)
+             for c, n in zip(canons, counts)]) \
+            if counts else np.zeros(0, np.int64)
+        valid = None
+        if any(c.valid is not None for c in canons):
+            valid = np.concatenate(
+                [_valid_np(c, n) for c, n in zip(canons, counts)])
+        out = Column(typ, offs, valid, None, lens, pool, pool2)
+        return _pad(out, cap)
+    sliced = [_take_flat(c, np.arange(n, dtype=np.int64))
+              for c, n in zip(cols, counts)]
+    return _pad(_concat_flat(sliced), cap)
+
+
+def _concat_flat(cols):
+    """Concatenate flat element pools (host)."""
+    if len(cols) == 1:
+        return cols[0]
+    typ = cols[0].type
+    if is_string(typ):
+        merged = cols[0].dictionary
+        remaps = [np.arange(len(merged), dtype=np.int64)]
+        for c in cols[1:]:
+            merged, _, ro = merged.merge(c.dictionary)
+            remaps.append(ro)
+        data = np.concatenate(
+            [r[_np(c.data).astype(np.int64)]
+             for c, r in zip(cols, remaps)]).astype(np.int32)
+        valid = _concat_valid(cols)
+        return Column(typ, data, valid, merged)
+    data = np.concatenate([_np(c.data) for c in cols])
+    valid = _concat_valid(cols)
+    d2 = None
+    if any(c.data2 is not None for c in cols):
+        d2 = np.concatenate(
+            [(_np(c.data2) if c.data2 is not None
+              else np.zeros(len(_np(c.data)), np.int64)) for c in cols])
+    children = None
+    if cols[0].children is not None:
+        children = tuple(
+            _concat_flat([c.children[i] for c in cols])
+            for i in range(len(cols[0].children)))
+    return Column(typ, data, valid, None, d2, cols[0].elements,
+                  cols[0].elements2, children)
+
+
+def _concat_valid(cols):
+    if all(c.valid is None for c in cols):
+        return None
+    return np.concatenate(
+        [(np.ones(len(_np(c.data)), bool) if c.valid is None
+          else _np(c.valid).astype(bool)) for c in cols])
+
+
+# --------------------------------------------------------------------------
+# array scalar functions
+# --------------------------------------------------------------------------
+
+def _comparable_lane(el: Column, n: int, probe: Optional[Column] = None):
+    """A numpy lane where == is value equality (and < is collation order
+    for strings); optionally aligns a probe column into the same code
+    space. Returns (lane, probe_lane|None)."""
+    data = _np(el.data)[:n]
+    if is_string(el.type):
+        ranks = el.dictionary.rank_codes()
+        if probe is not None:
+            merged, rk, rp = el.dictionary.merge(probe.dictionary)
+            mranks = merged.rank_codes()
+            lane = mranks[rk[data.astype(np.int64)]] if n else data
+            pl = mranks[rp[_np(probe.data).astype(np.int64)]]
+            return lane, pl
+        return ranks[data.astype(np.int64)] if n else data, None
+    pl = None if probe is None else _np(probe.data)
+    return data, pl
+
+
+def _contains(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    probe = _eval(e.args[1], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    lane, pl = _comparable_lane(canon.elements, total, probe)
+    match = lane == pl[owner] if total else np.zeros(0, bool)
+    if canon.elements.valid is not None:
+        match &= _np(canon.elements.valid)[:total].astype(bool)
+    out = np.zeros(cap, dtype=bool)
+    np.logical_or.at(out, owner, match)
+    valid = _valid_np(arr, cap) & _valid_np(probe, cap)
+    return Column(BOOLEAN, out, None if valid.all() else valid)
+
+
+def _array_position(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    probe = _eval(e.args[1], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    lane, pl = _comparable_lane(canon.elements, total, probe)
+    match = lane == pl[owner] if total else np.zeros(0, bool)
+    if canon.elements.valid is not None:
+        match &= _np(canon.elements.valid)[:total].astype(bool)
+    offs = _np(canon.data).astype(np.int64)
+    pos = np.zeros(cap, dtype=np.int64)
+    mi = np.nonzero(match)[0][::-1]  # reversed: first match wins
+    pos[owner[mi]] = mi - offs[owner[mi]] + 1
+    valid = _valid_np(arr, cap) & _valid_np(probe, cap)
+    return Column(BIGINT, pos, None if valid.all() else valid)
+
+
+def _array_minmax(kind: str):
+    def f(e: Call, batch: Batch) -> Column:
+        arr = _eval(e.args[0], batch)
+        cap = batch.capacity
+        canon = canonicalize(arr, cap)
+        owner = _owners(canon, cap)
+        total = len(owner)
+        el = canon.elements
+        lane, _ = _comparable_lane(el, total)
+        evalid = (np.ones(total, bool) if el.valid is None
+                  else _np(el.valid)[:total].astype(bool))
+        # NULL element -> result NULL (reference array_min/max)
+        has_null = np.zeros(cap, dtype=bool)
+        np.logical_or.at(has_null, owner, ~evalid)
+        if total and np.issubdtype(lane.dtype, np.floating):
+            sent = np.inf if kind == "min" else -np.inf
+        else:
+            ii = np.iinfo(lane.dtype if total else np.int64)
+            sent = ii.max if kind == "min" else ii.min
+        best = np.full(cap, sent, dtype=lane.dtype if total
+                       else np.int64)
+        op = np.minimum if kind == "min" else np.maximum
+        if total:
+            op.at(best, owner, np.where(evalid, lane, sent))
+        lens = np.where(_valid_np(canon, cap),
+                        _np(canon.data2)[:cap].astype(np.int64), 0)
+        valid = _valid_np(arr, cap) & (lens > 0) & ~has_null
+        if is_string(el.type):
+            # map collation rank back to a code: pick the element whose
+            # rank equals best via position trick
+            ranks = el.dictionary.rank_codes()
+            inv = np.argsort(ranks)
+            codes = inv[np.clip(best, 0, len(inv) - 1)].astype(np.int32) \
+                if len(inv) else best.astype(np.int32)
+            return Column(el.type, codes,
+                          None if valid.all() else valid, el.dictionary)
+        return Column(el.type, best.astype(_np(el.data).dtype),
+                      None if valid.all() else valid)
+    return f
+
+
+def _array_distinct(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    el = canon.elements
+    lane, _ = _comparable_lane(el, total)
+    evalid = (np.ones(total, bool) if el.valid is None
+              else _np(el.valid)[:total].astype(bool))
+    # keep the FIRST occurrence of each (owner, value); NULLs collapse
+    # to one
+    vkey = np.where(evalid, lane.astype(np.int64), np.int64(0))
+    order = np.lexsort((np.arange(total), vkey, ~evalid, owner))
+    so, sk, sv = owner[order], vkey[order], evalid[order]
+    first = np.ones(total, dtype=bool)
+    if total > 1:
+        first[1:] = (so[1:] != so[:-1]) | (sk[1:] != sk[:-1]) \
+            | (sv[1:] != sv[:-1])
+    keep = np.sort(order[first])
+    k_owner = owner[keep]
+    lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    new_el = _take_flat(el, keep)
+    return Column(e.type, offs, arr.valid if arr.valid is None else
+                  _valid_np(arr, cap), None, lens, new_el)
+
+
+def _array_sort(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    el = canon.elements
+    lane, _ = _comparable_lane(el, total)
+    evalid = (np.ones(total, bool) if el.valid is None
+              else _np(el.valid)[:total].astype(bool))
+    # ascending, NULLs last (reference array_sort)
+    order = np.lexsort((lane, np.where(evalid, 0, 1), owner))
+    new_el = _take_flat(el, order)
+    return Column(e.type, canon.data, canon.valid, None, canon.data2,
+                  new_el)
+
+
+def _slice(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    start = _eval(e.args[1], batch)
+    length = _eval(e.args[2], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    lens = np.where(_valid_np(canon, cap),
+                    _np(canon.data2)[:cap].astype(np.int64), 0)
+    offs = _np(canon.data)[:cap].astype(np.int64)
+    s = _np(start.data)[:cap].astype(np.int64)
+    ln = np.maximum(_np(length.data)[:cap].astype(np.int64), 0)
+    begin = np.where(s > 0, s - 1, lens + s)  # 1-based / from-end
+    begin_c = np.clip(begin, 0, lens)
+    new_lens = np.clip(np.minimum(ln, lens - begin_c), 0, None)
+    new_lens = np.where((s == 0) | (begin < 0) | (begin >= lens), 0,
+                        new_lens)
+    new_offs = np.concatenate([[0],
+                               np.cumsum(new_lens)[:-1]]).astype(np.int64)
+    owner = np.repeat(np.arange(cap, dtype=np.int64), new_lens)
+    j = (np.arange(int(new_lens.sum()), dtype=np.int64)
+         - np.repeat(new_offs, new_lens))
+    src = offs[owner] + begin_c[owner] + j
+    new_el = _take_flat(canon.elements, src)
+    valid = _valid_np(arr, cap) & _valid_np(start, cap) \
+        & _valid_np(length, cap)
+    return Column(e.type, new_offs, None if valid.all() else valid,
+                  None, new_lens, new_el)
+
+
+def _repeat(e: Call, batch: Batch) -> Column:
+    val = _eval(e.args[0], batch)
+    cnt = _eval(e.args[1], batch)
+    cap = batch.capacity
+    n = np.clip(_np(cnt.data)[:cap].astype(np.int64), 0, None)
+    offs = np.concatenate([[0], np.cumsum(n)[:-1]]).astype(np.int64)
+    owner = np.repeat(np.arange(cap, dtype=np.int64), n)
+    el = _take_flat(val, owner)
+    valid = _valid_np(cnt, cap)
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  n, el)
+
+
+def _sequence(e: Call, batch: Batch) -> Column:
+    lo = _eval(e.args[0], batch)
+    hi = _eval(e.args[1], batch)
+    cap = batch.capacity
+    valid = _valid_np(lo, cap) & _valid_np(hi, cap)
+    if len(e.args) > 2:
+        stepc = _eval(e.args[2], batch)
+        step = _np(stepc.data)[:cap].astype(np.int64)
+        valid = valid & _valid_np(stepc, cap)
+    else:
+        step = np.ones(cap, dtype=np.int64)
+    a = _np(lo.data)[:cap].astype(np.int64)
+    b = _np(hi.data)[:cap].astype(np.int64)
+    n = batch.num_rows_host() if not isinstance(batch.num_rows, int) \
+        else batch.num_rows
+    live = np.arange(cap) < n
+    if np.any((step == 0) & valid & live):
+        raise _err()("sequence step must not be zero")
+    safe_step = np.where(step == 0, 1, step)
+    lens = np.maximum((b - a) // safe_step + 1, 0)
+    lens = np.where(valid & live, lens, 0)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    owner = np.repeat(np.arange(cap, dtype=np.int64), lens)
+    j = (np.arange(int(lens.sum()), dtype=np.int64)
+         - np.repeat(offs, lens))
+    flat = a[owner] + j * safe_step[owner]
+    el = Column(e.type.element, flat.astype(np.int64), None)
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  lens, el)
+
+
+def _flatten(e: Call, batch: Batch) -> Column:
+    """flatten(array(array(E))) -> array(E)."""
+    arr = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)   # outer arrays canonical
+    owner = _owners(canon, cap)
+    inner = canon.elements           # ARRAY-typed flat pool
+    total = len(owner)
+    ioffs = _np(inner.data)[:total].astype(np.int64)
+    ilens = _np(inner.data2)[:total].astype(np.int64)
+    if inner.valid is not None:
+        ilens = np.where(_np(inner.valid)[:total].astype(bool), ilens, 0)
+    out_lens = np.zeros(cap, dtype=np.int64)
+    np.add.at(out_lens, owner, ilens)
+    out_offs = np.concatenate([[0],
+                               np.cumsum(out_lens)[:-1]]).astype(np.int64)
+    # expand: for each inner array, its elements in order
+    rep_inner = np.repeat(np.arange(total, dtype=np.int64), ilens)
+    grand = int(ilens.sum())
+    j = (np.arange(grand, dtype=np.int64)
+         - np.repeat(np.concatenate([[0], np.cumsum(ilens)[:-1]]),
+                     ilens))
+    src = ioffs[rep_inner] + j
+    el = _take_flat(inner.elements, src)
+    return Column(e.type, out_offs, arr.valid, None, out_lens, el)
+
+
+def _array_setop(kind: str):
+    """array_union / array_intersect / array_except, fully vectorized:
+    sort combined (owner, value, source) entries, derive distinct-value
+    groups + per-source presence, keep groups per set semantics, emit
+    each kept group's first entry."""
+    def f(e: Call, batch: Batch) -> Column:
+        a1 = _eval(e.args[0], batch)
+        a2 = _eval(e.args[1], batch)
+        cap = batch.capacity
+        c1 = canonicalize(a1, cap)
+        c2 = canonicalize(a2, cap)
+        o1, o2 = _owners(c1, cap), _owners(c2, cap)
+        t1, t2 = len(o1), len(o2)
+        e1, e2 = c1.elements, c2.elements
+        if is_string(e1.type) or is_string(e2.type):
+            merged, r1, r2 = e1.dictionary.merge(e2.dictionary)
+            ranks = merged.rank_codes()
+            l1 = ranks[r1[_np(e1.data)[:t1].astype(np.int64)]] if t1 \
+                else np.zeros(0, np.int64)
+            l2 = ranks[r2[_np(e2.data)[:t2].astype(np.int64)]] if t2 \
+                else np.zeros(0, np.int64)
+        else:
+            l1, l2 = _np(e1.data)[:t1], _np(e2.data)[:t2]
+        v1 = (np.ones(t1, bool) if e1.valid is None
+              else _np(e1.valid)[:t1].astype(bool))
+        v2 = (np.ones(t2, bool) if e2.valid is None
+              else _np(e2.valid)[:t2].astype(bool))
+        owner = np.concatenate([o1, o2])
+        nl = np.concatenate([~v1, ~v2])
+        lk = np.where(~nl,
+                      np.concatenate([l1, l2]).astype(np.int64), 0)
+        srcarr = np.concatenate([np.zeros(t1, np.int64),
+                                 np.ones(t2, np.int64)])
+        within = np.concatenate([np.arange(t1, dtype=np.int64),
+                                 np.arange(t2, dtype=np.int64)])
+        total = len(owner)
+        order = np.lexsort((within, srcarr, lk, nl, owner))
+        so = owner[order]
+        sn, sk = nl[order], lk[order]
+        is_first = np.ones(total, bool)
+        if total > 1:
+            is_first[1:] = ((so[1:] != so[:-1]) | (sn[1:] != sn[:-1])
+                            | (sk[1:] != sk[:-1]))
+        gidv = np.cumsum(is_first) - 1
+        ngroups = int(gidv[-1]) + 1 if total else 0
+        pres = np.zeros((2, max(ngroups, 1)), bool)
+        ss = srcarr[order]
+        np.logical_or.at(pres[0], gidv[ss == 0], True)
+        np.logical_or.at(pres[1], gidv[ss == 1], True)
+        if kind == "union":
+            keep_grp = np.ones(max(ngroups, 1), bool)
+        elif kind == "intersect":
+            keep_grp = pres[0] & pres[1]
+        else:
+            keep_grp = pres[0] & ~pres[1]
+        rep = order[is_first]            # first entry of each group
+        sel = keep_grp[:ngroups] if ngroups else np.zeros(0, bool)
+        rep_keep = rep[sel]
+        k_owner = owner[rep_keep]
+        lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+        offs = np.concatenate([[0],
+                               np.cumsum(lens)[:-1]]).astype(np.int64)
+        el = _gather_multi([e1, e2], srcarr[rep_keep],
+                           within[rep_keep])
+        valid = _valid_np(a1, cap) & _valid_np(a2, cap)
+        return Column(e.type, offs, None if valid.all() else valid,
+                      None, lens, el)
+    return f
+
+
+def _arrays_overlap(e: Call, batch: Batch) -> Column:
+    inter = _array_setop("intersect")(
+        Call("array_intersect", e.args,
+             _eval(e.args[0], batch).type), batch)
+    lens = _np(inter.data2).astype(np.int64)
+    return Column(BOOLEAN, lens > 0, inter.valid)
+
+
+# --------------------------------------------------------------------------
+# higher-order functions
+# --------------------------------------------------------------------------
+
+def _transform(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    lam: Lambda = e.args[1]
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    eb = _element_batch({lam.params[0]: canon.elements}, lam.body,
+                        batch, owner)
+    out_el = _eval(lam.body, eb)
+    return _rebuild(e.type, canon, out_el)
+
+
+def _filter_arr(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    lam: Lambda = e.args[1]
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    eb = _element_batch({lam.params[0]: canon.elements}, lam.body,
+                        batch, owner)
+    pred = _eval(lam.body, eb)
+    keepm = _np(pred.data)[:total].astype(bool)
+    if pred.valid is not None:
+        keepm &= _np(pred.valid)[:total].astype(bool)
+    keep = np.nonzero(keepm)[0]
+    k_owner = owner[keep]
+    lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    el = _take_flat(canon.elements, keep)
+    return Column(e.type, offs, canon.valid, None, lens, el)
+
+
+def _match(kind: str):
+    def f(e: Call, batch: Batch) -> Column:
+        arr = _eval(e.args[0], batch)
+        lam: Lambda = e.args[1]
+        cap = batch.capacity
+        canon = canonicalize(arr, cap)
+        owner = _owners(canon, cap)
+        total = len(owner)
+        eb = _element_batch({lam.params[0]: canon.elements}, lam.body,
+                            batch, owner)
+        pred = _eval(lam.body, eb)
+        pv = _np(pred.data)[:total].astype(bool)
+        pnull = (~_np(pred.valid)[:total].astype(bool)
+                 if pred.valid is not None else np.zeros(total, bool))
+        any_true = np.zeros(cap, bool)
+        any_false = np.zeros(cap, bool)
+        any_null = np.zeros(cap, bool)
+        np.logical_or.at(any_true, owner, pv & ~pnull)
+        np.logical_or.at(any_false, owner, ~pv & ~pnull)
+        np.logical_or.at(any_null, owner, pnull)
+        valid = _valid_np(arr, cap)
+        if kind == "any":
+            # TRUE if any true; NULL if none true but a null; else FALSE
+            out = any_true
+            nul = ~any_true & any_null
+        elif kind == "all":
+            # FALSE if any false; NULL if no false but a null; else TRUE
+            out = ~any_false & ~any_null
+            nul = ~any_false & any_null
+        else:  # none
+            out = ~any_true & ~any_null
+            nul = ~any_true & any_null
+        valid = valid & ~nul
+        return Column(BOOLEAN, out, None if valid.all() else valid)
+    return f
+
+
+def _reduce(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    init = _eval(e.args[1], batch)
+    step: Lambda = e.args[2]
+    outfn: Lambda = e.args[3]
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    offs = _np(canon.data)[:cap].astype(np.int64)
+    lens = np.where(_valid_np(canon, cap),
+                    _np(canon.data2)[:cap].astype(np.int64), 0)
+    maxlen = int(lens.max()) if cap else 0
+    state = init
+    ssym, esym = step.params
+    from dataclasses import replace as _rp
+    for j in range(maxlen):
+        idx = offs + j
+        live = j < lens
+        elem = _take_flat(canon.elements, np.where(live, idx, 0))
+        eb_cols = {ssym: state, esym: elem}
+        free = input_names(step.body) - set(step.params)
+        for name in free:
+            if name in batch.columns:
+                eb_cols[name] = batch.columns[name]
+        nb = Batch(eb_cols, cap)
+        new_state = _eval(step.body, nb)
+        # rows whose array is exhausted keep their state
+        sv = _valid_np(state, cap)
+        nv = _valid_np(new_state, cap)
+        valid = np.where(live, nv, sv)
+        if is_string(new_state.type):
+            # codes from the two states live in different dictionaries:
+            # unify before selecting per-row
+            merged, rs, rn = state.dictionary.merge(
+                new_state.dictionary)
+            sd = rs[_np(state.data)[:cap].astype(np.int64)]
+            nd = rn[_np(new_state.data)[:cap].astype(np.int64)]
+            data = np.where(live, nd, sd).astype(np.int32)
+            state = Column(new_state.type, data,
+                           None if valid.all() else valid, merged)
+        else:
+            data = np.where(live, _np(new_state.data)[:cap],
+                            _np(state.data)[:cap])
+            d2 = None
+            if new_state.data2 is not None or state.data2 is not None:
+                zero = np.zeros(cap, np.int64)
+                d2 = np.where(
+                    live,
+                    (_np(new_state.data2)[:cap]
+                     if new_state.data2 is not None else zero),
+                    (_np(state.data2)[:cap]
+                     if state.data2 is not None else zero))
+            state = Column(new_state.type, data,
+                           None if valid.all() else valid, None, d2)
+    ob = Batch({outfn.params[0]: state, **{
+        n: batch.columns[n]
+        for n in (input_names(outfn.body) - set(outfn.params))
+        if n in batch.columns}}, cap)
+    out = _eval(outfn.body, ob)
+    av = _valid_np(arr, cap)
+    ov = _valid_np(out, cap) & av
+    return _rp(out, valid=None if ov.all() else ov)
+
+
+def _zip_with(e: Call, batch: Batch) -> Column:
+    a1 = _eval(e.args[0], batch)
+    a2 = _eval(e.args[1], batch)
+    lam: Lambda = e.args[2]
+    cap = batch.capacity
+    c1, c2 = canonicalize(a1, cap), canonicalize(a2, cap)
+    l1 = np.where(_valid_np(c1, cap),
+                  _np(c1.data2)[:cap].astype(np.int64), 0)
+    l2 = np.where(_valid_np(c2, cap),
+                  _np(c2.data2)[:cap].astype(np.int64), 0)
+    lens = np.maximum(l1, l2)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    total = int(lens.sum())
+    owner = np.repeat(np.arange(cap, dtype=np.int64), lens)
+    j = np.arange(total, dtype=np.int64) - np.repeat(offs, lens)
+    from dataclasses import replace as _rp
+
+    def pad_el(c, ln):
+        src = _np(c.data)[:cap].astype(np.int64)[owner] + j
+        inb = j < ln[owner]
+        el = _take_flat(c.elements, np.where(inb, src, 0))
+        v = inb if el.valid is None else \
+            (_np(el.valid).astype(bool) & inb)
+        return _rp(el, valid=v)
+
+    e1, e2 = pad_el(c1, l1), pad_el(c2, l2)
+    eb = _element_batch({lam.params[0]: e1, lam.params[1]: e2},
+                        lam.body, batch, owner)
+    out_el = _eval(lam.body, eb)
+    valid = _valid_np(a1, cap) & _valid_np(a2, cap)
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  lens, out_el)
+
+
+def _map_lambda(which: str):
+    """map_filter / transform_keys / transform_values."""
+    def f(e: Call, batch: Batch) -> Column:
+        m = _eval(e.args[0], batch)
+        lam: Lambda = e.args[1]
+        cap = batch.capacity
+        canon = canonicalize(m, cap)
+        owner = _owners(canon, cap)
+        total = len(owner)
+        eb = _element_batch({lam.params[0]: canon.elements,
+                             lam.params[1]: canon.elements2},
+                            lam.body, batch, owner)
+        out = _eval(lam.body, eb)
+        if which == "filter":
+            keepm = _np(out.data)[:total].astype(bool)
+            if out.valid is not None:
+                keepm &= _np(out.valid)[:total].astype(bool)
+            keep = np.nonzero(keepm)[0]
+            k_owner = owner[keep]
+            lens = np.bincount(k_owner,
+                               minlength=cap).astype(np.int64)[:cap]
+            offs = np.concatenate(
+                [[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+            return Column(e.type, offs, canon.valid, None, lens,
+                          _take_flat(canon.elements, keep),
+                          _take_flat(canon.elements2, keep))
+        if which == "keys":
+            return Column(e.type, canon.data, canon.valid, None,
+                          canon.data2, out, canon.elements2)
+        return Column(e.type, canon.data, canon.valid, None,
+                      canon.data2, canon.elements, out)
+    return f
+
+
+def _map_zip_with(e: Call, batch: Batch) -> Column:
+    """map_zip_with(m1, m2, (k, v1, v2) -> ...): key union per row;
+    a key absent from one side binds its value parameter to NULL
+    (reference: operator/scalar/MapZipWithFunction.java)."""
+    m1 = canonicalize(_eval(e.args[0], batch), batch.capacity)
+    m2 = canonicalize(_eval(e.args[1], batch), batch.capacity)
+    lam: Lambda = e.args[2]
+    cap = batch.capacity
+    o1, o2 = _owners(m1, cap), _owners(m2, cap)
+    t1, t2 = len(o1), len(o2)
+    k1, k2 = m1.elements, m2.elements
+    if is_string(k1.type) or is_string(k2.type):
+        merged, r1, r2 = k1.dictionary.merge(k2.dictionary)
+        l1 = r1[_np(k1.data)[:t1].astype(np.int64)] if t1 else \
+            np.zeros(0, np.int64)
+        l2 = r2[_np(k2.data)[:t2].astype(np.int64)] if t2 else \
+            np.zeros(0, np.int64)
+    else:
+        l1 = _np(k1.data)[:t1].astype(np.int64)
+        l2 = _np(k2.data)[:t2].astype(np.int64)
+    owner = np.concatenate([o1, o2])
+    keyl = np.concatenate([l1, l2])
+    srcarr = np.concatenate([np.zeros(t1, np.int64),
+                             np.ones(t2, np.int64)])
+    within = np.concatenate([np.arange(t1, dtype=np.int64),
+                             np.arange(t2, dtype=np.int64)])
+    total = len(owner)
+    order = np.lexsort((within, srcarr, keyl, owner))
+    so, sk = owner[order], keyl[order]
+    is_first = np.ones(total, bool)
+    if total > 1:
+        is_first[1:] = (so[1:] != so[:-1]) | (sk[1:] != sk[:-1])
+    gidv = np.cumsum(is_first) - 1
+    ngroups = int(gidv[-1]) + 1 if total else 0
+    ss = srcarr[order]
+    # first entry per (owner,key) group from EACH source (-1 = absent);
+    # reversed scatter so the earliest sorted position wins
+    src_idx = [np.full(max(ngroups, 1), -1, np.int64) for _ in (0, 1)]
+    for s in (0, 1):
+        selpos = np.nonzero(ss == s)[0][::-1]
+        src_idx[s][gidv[selpos]] = order[selpos]
+    ue = order[is_first]           # union entries, owner-major
+    u_owner = owner[ue]
+    ug = gidv[is_first]
+    keys_pool = _gather_multi([k1, k2], srcarr[ue], within[ue])
+    from dataclasses import replace as _rp
+
+    def side_values(s, pool):
+        idx = src_idx[s][ug]
+        present = idx >= 0
+        w = np.where(present, within[np.clip(idx, 0, max(total - 1, 0))]
+                     if total else 0, 0)
+        col = _take_flat(pool, np.asarray(w, np.int64))
+        v = present if col.valid is None else \
+            (_np(col.valid).astype(bool) & present)
+        return _rp(col, valid=v)
+
+    v1 = side_values(0, m1.elements2)
+    v2 = side_values(1, m2.elements2)
+    eb = _element_batch({lam.params[0]: keys_pool,
+                         lam.params[1]: v1, lam.params[2]: v2},
+                        lam.body, batch, u_owner)
+    out_vals = _eval(lam.body, eb)
+    lens = np.bincount(u_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    valid = _valid_np(m1, cap) & _valid_np(m2, cap)
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  lens, keys_pool, out_vals)
+
+
+DISPATCH = {
+    "$map": _map_ctor,
+    "$row": _row_ctor,
+    "$field": _row_field,
+    "map": _map_ctor,
+    "map_keys": _map_keys,
+    "map_values": _map_values,
+    "map_entries": _map_entries,
+    "map_concat": _map_concat,
+    "contains": _contains,
+    "array_position": _array_position,
+    "array_min": _array_minmax("min"),
+    "array_max": _array_minmax("max"),
+    "array_distinct": _array_distinct,
+    "array_sort": _array_sort,
+    "slice": _slice,
+    "repeat": _repeat,
+    "sequence": _sequence,
+    "flatten": _flatten,
+    "array_union": _array_setop("union"),
+    "array_intersect": _array_setop("intersect"),
+    "array_except": _array_setop("except"),
+    "arrays_overlap": _arrays_overlap,
+    "transform": _transform,
+    "filter": _filter_arr,
+    "any_match": _match("any"),
+    "all_match": _match("all"),
+    "none_match": _match("none"),
+    "reduce": _reduce,
+    "zip_with": _zip_with,
+    "map_filter": _map_lambda("filter"),
+    "transform_keys": _map_lambda("keys"),
+    "transform_values": _map_lambda("values"),
+    "map_zip_with": _map_zip_with,
+}

@@ -200,9 +200,10 @@ class DistributedExecutor(Executor):
         if not isinstance(src, ShardedBatch):
             return super()._exec_AggregationNode(
                 dc_replace(node, source=_Pre(src)))
-        if any(a.kind == "array_agg" for a in node.aggregates.values()):
-            # array offsets don't survive shard-local numbering; gather
-            # to the coordinator shard and aggregate locally
+        if any(a.kind in ("array_agg", "map_agg", "histogram")
+               for a in node.aggregates.values()):
+            # array/map offsets don't survive shard-local numbering;
+            # gather to the coordinator shard and aggregate locally
             return super()._exec_AggregationNode(
                 dc_replace(node, source=_Pre(self._host(src))))
         # lower avg & friends against the global sharded lanes (extra

@@ -171,7 +171,8 @@ class Executor:
     _STREAM_CHAIN = None   # set after class body
 
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
-                      "approx_percentile", "array_agg"}
+                      "approx_percentile", "array_agg", "map_agg",
+                      "histogram"}
 
     def _try_streaming_aggregation(self, node: AggregationNode):
         # kinds whose partials don't combine with a single-lane segment
@@ -1048,6 +1049,11 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
                                  sym))
         elif kind == "array_agg":
             phys.append(AggInput("array_agg", a.argument, a.mask, sym))
+        elif kind == "map_agg":
+            phys.append(AggInput("map_agg", a.argument, a.mask, sym,
+                                 input2=a.argument2))
+        elif kind == "histogram":
+            phys.append(AggInput("histogram", a.argument, a.mask, sym))
         elif kind == "approx_percentile":
             phys.append(AggInput("percentile", a.argument, a.mask, sym,
                                  param=a.param))
@@ -1323,6 +1329,12 @@ def device_concat(parts: Sequence[Batch]) -> Batch:
     for name in names:
         cols = [p.column(name) for p in parts]
         typ = cols[0].type
+        if cols[0].elements is not None or cols[0].children is not None:
+            # pooled (ARRAY/MAP/ROW) columns merge host-side with
+            # rebased offsets (exec/complex.py)
+            from .complex import concat_columns_host
+            out_cols[name] = concat_columns_host(cols, counts, cap)
+            continue
         if is_string(typ):
             merged = cols[0].dictionary
             remaps = [np.arange(len(merged), dtype=np.int32)]

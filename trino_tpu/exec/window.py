@@ -152,8 +152,24 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
         vals = live_s.astype(jnp.int64)
         valid_lane = live_s
 
+    if _explicit_frame(fn) and k not in ("lag", "lead"):
+        return _framed_eval(fn, src, order, live_s, pid, pos,
+                            part_start, part_size, peer_boundary, node,
+                            vals, valid_lane)
+
     unbounded_end = (fn.frame_end in ("unbounded_following",)
                      or not node.order_by)
+    # default RANGE frame ends at the CURRENT ROW'S PEER GROUP end (SQL
+    # standard; operator/window/WindowPartition peer handling): running
+    # values are read at the peer-group-end position. ROWS frames end
+    # at the row itself.
+    peer_id = jnp.clip(jnp.cumsum(peer_boundary.astype(jnp.int64)) - 1,
+                       0, cap - 1).astype(jnp.int32)
+    peer_end = jax.ops.segment_max(
+        jnp.where(live_s, pos, jnp.int64(-1)), peer_id,
+        num_segments=cap)
+    frame_pos = (pos if fn.frame_unit == "rows"
+                 else jnp.clip(jnp.take(peer_end, peer_id), 0, cap - 1))
 
     if k in ("first_value",):
         first_pos = jnp.take(part_start, pid)
@@ -163,7 +179,7 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
             last_pos = jnp.take(part_start, pid) + \
                 jnp.take(part_size, pid) - 1
         else:
-            last_pos = pos  # running frame: current row
+            last_pos = frame_pos  # frame end (peers for RANGE)
         last_pos = jnp.clip(last_pos, 0, cap - 1)
         return jnp.take(vals, last_pos), jnp.take(valid_lane, last_pos)
     if k == "nth_value":
@@ -234,7 +250,7 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
             return jnp.take(total, pid), None
         run = jnp.cumsum(lane)
         base = _part_base(run, lane, part_start, pid)
-        return run - base, None
+        return jnp.take(run, frame_pos) - base, None
     if k == "sum":
         acc = masked.astype(
             jnp.float64 if vals.dtype in (jnp.float32, jnp.float64)
@@ -250,7 +266,8 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
         runv = jnp.cumsum(valid_lane.astype(jnp.int64))
         vbase = _part_base(runv, valid_lane.astype(jnp.int64),
                            part_start, pid)
-        return ((run - base).astype(vals.dtype), (runv - vbase) > 0)
+        return ((jnp.take(run, frame_pos) - base).astype(vals.dtype),
+                (jnp.take(runv, frame_pos) - vbase) > 0)
     if k == "avg":
         acc = masked.astype(jnp.float64)
         cnt = valid_lane.astype(jnp.int64)
@@ -260,8 +277,10 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
             s, n = jnp.take(s, pid), jnp.take(n, pid)
         else:
             rs, rn = jnp.cumsum(acc), jnp.cumsum(cnt)
-            s = rs - _part_base(rs, acc, part_start, pid)
-            n = rn - _part_base(rn, cnt, part_start, pid)
+            s = jnp.take(rs, frame_pos) - _part_base(rs, acc,
+                                                     part_start, pid)
+            n = jnp.take(rn, frame_pos) - _part_base(rn, cnt,
+                                                     part_start, pid)
         return s / jnp.maximum(n.astype(jnp.float64), 1.0), n > 0
     if k in ("min", "max"):
         seg = jax.ops.segment_min if k == "min" else jax.ops.segment_max
@@ -287,8 +306,208 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
         runv = jnp.cumsum(valid_lane.astype(jnp.int64))
         vbase = _part_base(runv, valid_lane.astype(jnp.int64),
                            part_start, pid)
-        return run, (runv - vbase) > 0
+        return (jnp.take(run, frame_pos),
+                (jnp.take(runv, frame_pos) - vbase) > 0)
     raise ValueError(f"window function '{k}' not implemented")
+
+
+def _explicit_frame(fn) -> bool:
+    """True when the function carries a frame the default running/
+    whole-partition paths can't express: offset bounds, GROUPS unit, or
+    non-default start/end (operator/window/FrameInfo.java)."""
+    return (fn.frame_start_value is not None
+            or fn.frame_end_value is not None
+            or fn.frame_unit == "groups"
+            or fn.frame_start not in ("unbounded_preceding",)
+            or fn.frame_end == "following")
+
+
+def _framed_eval(fn, src, order, live_s, pid, pos, part_start,
+                 part_size, peer_boundary, node, vals, valid_lane):
+    """Explicit-frame evaluation (ROWS/RANGE/GROUPS BETWEEN ... ):
+    compute inclusive [lo, hi] sorted-position bounds per row, then
+    every aggregate is a prefix-sum difference (min/max: a host sparse
+    table — windows evaluate eagerly, WindowNode is not chain-jitted).
+    Reference: operator/window/WindowPartition.updateFrame +
+    AggregateWindowFunction."""
+    import numpy as np
+    cap = int(pos.shape[0])
+    posn = np.arange(cap, dtype=np.int64)
+    pidn = np.asarray(pid)
+    ps = np.asarray(jnp.take(part_start, pid))
+    pe = ps + np.asarray(jnp.take(part_size, pid)) - 1
+    unit = fn.frame_unit
+    k = fn.kind
+
+    if unit != "rows":
+        peerb = np.asarray(peer_boundary)
+        gidx = np.cumsum(peerb.astype(np.int64)) - 1
+        gidx = np.clip(gidx, 0, cap - 1)
+        g_start = np.full(cap, cap, np.int64)
+        np.minimum.at(g_start, gidx, posn)
+        g_end = np.full(cap, -1, np.int64)
+        np.maximum.at(g_end, gidx, posn)
+
+    def group_of_offset(delta):
+        """peer-group index shifted by delta, clamped to the
+        partition's group range."""
+        first_g = gidx[np.clip(ps, 0, cap - 1)]
+        last_g = gidx[np.clip(pe, 0, cap - 1)]
+        return np.clip(gidx + delta, first_g, last_g)
+
+    def bound(which):
+        btype = fn.frame_start if which == "start" else fn.frame_end
+        bval = fn.frame_start_value if which == "start" \
+            else fn.frame_end_value
+        if btype == "unbounded_preceding":
+            return ps.copy()
+        if btype == "unbounded_following":
+            return pe.copy()
+        if btype == "current":
+            if unit == "rows":
+                return posn.copy()
+            # RANGE/GROUPS: current row means the whole peer group
+            return g_start[gidx] if which == "start" else g_end[gidx]
+        sign = -1 if btype == "preceding" else 1
+        n = int(bval or 0)
+        if unit == "rows":
+            return posn + sign * n
+        if unit == "groups":
+            g = group_of_offset(sign * n)
+            return g_start[g] if which == "start" else g_end[g]
+        # RANGE with a value offset: per-partition searchsorted over
+        # the (single, numeric) order key. Descending keys are negated
+        # so the sorted lane is ascending — in that mirrored space
+        # "preceding" is STILL the smaller side, so the offset sign
+        # does not flip. NULL keys sort into their own contiguous run;
+        # they are excluded from the search segment and a NULL-key
+        # row's frame is its null peer group (SQL: NULL is peer only
+        # with NULL).
+        if len(node.order_by) != 1:
+            raise ValueError(
+                "RANGE offset frames require exactly one ORDER BY key")
+        key = node.order_by[0]
+        kcol = src.column(key.symbol)
+        lane = np.asarray(jnp.take(jnp.asarray(kcol.data), order))
+        if lane.dtype == np.bool_ or kcol.dictionary is not None:
+            raise ValueError(
+                "RANGE offset frames require a numeric ORDER BY key")
+        kvalid = (np.ones(cap, bool) if kcol.valid is None
+                  else np.asarray(jnp.take(jnp.asarray(kcol.valid),
+                                           order)))
+        if not key.ascending:
+            lane = -lane
+        target = lane + sign * n
+        out = np.empty(cap, np.int64)
+        starts = np.unique(np.asarray(ps))
+        for s in starts:
+            sel = np.nonzero((np.asarray(ps) == s))[0]
+            if len(sel) == 0:
+                continue
+            e = int(pe[sel[0]])
+            vpos = np.nonzero(kvalid[s:e + 1])[0]
+            if len(vpos):
+                vs, ve = s + vpos[0], s + vpos[-1]
+                seg = lane[vs:ve + 1]
+                vsel = sel[kvalid[sel]]
+                t = target[vsel]
+                if which == "start":
+                    out[vsel] = vs + np.searchsorted(seg, t,
+                                                     side="left")
+                else:
+                    out[vsel] = vs + np.searchsorted(
+                        seg, t, side="right") - 1
+            nsel = sel[~kvalid[sel]]
+            if len(nsel):
+                out[nsel] = (g_start[gidx[nsel]] if which == "start"
+                             else g_end[gidx[nsel]])
+        return out
+
+    lo = np.maximum(bound("start"), ps)
+    hi = np.minimum(bound("end"), pe)
+    empty = lo > hi
+    lo_c = np.clip(lo, 0, cap - 1)
+    hi_c = np.clip(hi, 0, cap - 1)
+
+    valid_n = np.asarray(valid_lane)
+    vals_n = np.asarray(vals)
+
+    if k in ("first_value", "last_value", "nth_value"):
+        if k == "first_value":
+            idx = lo_c
+            ok = ~empty
+        elif k == "last_value":
+            idx = hi_c
+            ok = ~empty
+        else:
+            ocol = src.column(fn.offset)
+            nth = np.asarray(jnp.take(
+                jnp.asarray(ocol.data).astype(jnp.int64), order))
+            idx = np.clip(lo_c + nth - 1, 0, cap - 1)
+            ok = ~empty & (nth >= 1) & (lo + nth - 1 <= hi)
+            if ocol.valid is not None:
+                ok &= np.asarray(jnp.take(jnp.asarray(ocol.valid),
+                                          order))
+        data = vals_n[idx]
+        return jnp.asarray(data), jnp.asarray(ok & valid_n[idx])
+
+    if k in ("count", "count_star"):
+        C = np.concatenate([[0], np.cumsum(valid_n.astype(np.int64))])
+        cnt = C[hi_c + 1] - C[lo_c]
+        cnt = np.where(empty, 0, cnt)
+        return jnp.asarray(cnt), None
+
+    if k in ("sum", "avg"):
+        acc_dt = np.float64 if vals_n.dtype.kind == "f" else np.int64
+        masked = np.where(valid_n, vals_n.astype(acc_dt), 0)
+        S = np.concatenate([[0], np.cumsum(masked)])
+        C = np.concatenate([[0], np.cumsum(valid_n.astype(np.int64))])
+        s = S[hi_c + 1] - S[lo_c]
+        c = C[hi_c + 1] - C[lo_c]
+        ok = ~empty & (c > 0)
+        if k == "avg":
+            return (jnp.asarray(s / np.maximum(c, 1).astype(np.float64)),
+                    jnp.asarray(ok))
+        return jnp.asarray(np.where(ok, s, 0).astype(vals_n.dtype)), \
+            jnp.asarray(ok)
+
+    if k in ("min", "max"):
+        if vals_n.dtype.kind == "f":
+            ident = np.inf if k == "min" else -np.inf
+        elif vals_n.dtype == np.bool_:
+            vals_n = vals_n.astype(np.int32)
+            ident = 2 if k == "min" else -1
+        else:
+            info = np.iinfo(vals_n.dtype)
+            ident = info.max if k == "min" else info.min
+        w = np.where(valid_n, vals_n, ident)
+        # sparse-table RMQ: O(n log n) build, O(1) per query
+        levels = [w]
+        span = 1
+        op = np.minimum if k == "min" else np.maximum
+        while span * 2 <= cap:
+            prev = levels[-1]
+            levels.append(op(prev[:len(prev) - span], prev[span:]))
+            span *= 2
+        length = hi_c - lo_c + 1
+        lvl = np.maximum(
+            np.int64(np.floor(np.log2(np.maximum(length, 1)))), 0)
+        span_of = (1 << lvl).astype(np.int64)
+        out = np.empty(cap, dtype=w.dtype)
+        for li, tbl in enumerate(levels):
+            m = lvl == li
+            if not m.any():
+                continue
+            a = lo_c[m]
+            b = np.clip(hi_c[m] - span_of[m] + 1, 0, len(tbl) - 1)
+            out[m] = op(tbl[np.clip(a, 0, len(tbl) - 1)], tbl[b])
+        C = np.concatenate([[0], np.cumsum(valid_n.astype(np.int64))])
+        c = C[hi_c + 1] - C[lo_c]
+        ok = ~empty & (c > 0)
+        return jnp.asarray(out), jnp.asarray(ok)
+
+    raise ValueError(
+        f"window function '{k}' does not support explicit frames")
 
 
 def _running_last_where(pos, flag):

@@ -1,0 +1,2 @@
+"""File-format libraries (reference: lib/trino-parquet, trino-orc,
+trino-rcfile). Readers produce columnar Batches directly."""

@@ -1,0 +1,512 @@
+"""From-scratch Parquet reader producing columnar Batches.
+
+Reference parity: lib/trino-parquet (8.9k loc — ParquetReader,
+MetadataReader, the typed column readers under reader/; the writer
+lives in trino-hive at the reference snapshot, so this is reader-only
+like the reference library). Nothing is delegated to pyarrow — the
+thrift-compact footer parser, RLE/bit-packed hybrid decoder, PLAIN /
+dictionary decoders, and a pure-python Snappy decompressor live here,
+with numpy doing the wide decodes (the TPU-first angle: every column
+lands as a dense lane ready for device upload).
+
+Supported surface (flat schemas):
+- physical types BOOLEAN, INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY
+- logical/converted types UTF8 -> VARCHAR, DATE -> DATE,
+  TIMESTAMP_MILLIS/MICROS -> TIMESTAMP(3)
+- encodings PLAIN, RLE (levels), PLAIN_DICTIONARY / RLE_DICTIONARY
+- codecs UNCOMPRESSED, SNAPPY, GZIP, ZSTD (via stdlib/zlib; snappy is
+  implemented below)
+- optional columns via definition levels; no repeated (nested) groups
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import Batch, Column, StringDictionary, pad_batch
+from ..config import capacity_for
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL,
+                     TimestampType, Type, VarcharType)
+
+MAGIC = b"PAR1"
+
+
+# --------------------------------------------------------------------------
+# thrift compact protocol (the footer/page-header wire format)
+# --------------------------------------------------------------------------
+
+class _TReader:
+    """Minimal thrift compact-protocol struct reader: structs become
+    {field_id: value} dicts; only what parquet.thrift needs."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def _varint(self) -> int:
+        out = 0
+        shift = 0
+        while True:
+            b = self._byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def _zigzag(self) -> int:
+        v = self._varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def _bytes(self) -> bytes:
+        n = self._varint()
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def _value(self, ttype: int):
+        if ttype == 1:
+            return True
+        if ttype == 2:
+            return False
+        if ttype in (3, 4, 5, 6):
+            return self._zigzag()
+        if ttype == 7:
+            v = struct.unpack("<d", self.buf[self.pos:self.pos + 8])[0]
+            self.pos += 8
+            return v
+        if ttype == 8:
+            return self._bytes()
+        if ttype in (9, 10):
+            return self._list()
+        if ttype == 12:
+            return self.struct()
+        raise ValueError(f"thrift type {ttype} unsupported")
+
+    def _list(self):
+        head = self._byte()
+        size = head >> 4
+        etype = head & 0x0F
+        if size == 15:
+            size = self._varint()
+        if etype == 1:          # bool list elements carry their value
+            return [self._byte() == 1 for _ in range(size)]
+        return [self._value(etype) for _ in range(size)]
+
+    def struct(self) -> Dict[int, object]:
+        out: Dict[int, object] = {}
+        fid = 0
+        while True:
+            head = self._byte()
+            if head == 0:
+                return out
+            delta = head >> 4
+            ttype = head & 0x0F
+            if delta == 0:
+                fid = self._zigzag()
+            else:
+                fid += delta
+            if ttype in (1, 2):
+                out[fid] = ttype == 1
+            else:
+                out[fid] = self._value(ttype)
+
+
+# --------------------------------------------------------------------------
+# snappy (pure python; raw block format)
+# --------------------------------------------------------------------------
+
+def snappy_decompress(data: bytes) -> bytes:
+    """Raw Snappy block decode: preamble varint = uncompressed length,
+    then literal / copy tags."""
+    pos = 0
+    # uncompressed length varint
+    n = 0
+    shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        n |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    out = bytearray()
+    while pos < len(data):
+        tag = data[pos]
+        pos += 1
+        kind = tag & 0x03
+        if kind == 0:                       # literal
+            length = (tag >> 2) + 1
+            if length > 60:
+                extra = length - 60
+                length = int.from_bytes(data[pos:pos + extra],
+                                        "little") + 1
+                pos += extra
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if kind == 1:                       # copy, 1-byte offset
+            length = ((tag >> 2) & 0x07) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == 2:                     # copy, 2-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:                               # copy, 4-byte offset
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0:
+            raise ValueError("snappy: zero copy offset")
+        start = len(out) - offset
+        for i in range(length):             # may self-overlap
+            out.append(out[start + i])
+    if len(out) != n:
+        raise ValueError("snappy: length mismatch")
+    return bytes(out)
+
+
+def _decompress(data: bytes, codec: int, uncompressed: int) -> bytes:
+    if codec == 0:
+        return data
+    if codec == 1:
+        return snappy_decompress(data)
+    if codec == 2:
+        return zlib.decompress(data, 31)    # gzip wrapper
+    if codec == 6:
+        try:
+            import zstandard                 # pragma: no cover
+            return zstandard.ZstdDecompressor().decompress(data)
+        except ImportError:
+            raise ValueError("zstd codec requires the zstandard module")
+    raise ValueError(f"compression codec {codec} unsupported")
+
+
+# --------------------------------------------------------------------------
+# RLE / bit-packed hybrid
+# --------------------------------------------------------------------------
+
+def _read_rle_bitpacked(buf: bytes, bit_width: int,
+                        count: int) -> np.ndarray:
+    """The RLE/bit-packing hybrid used for levels and dictionary ids."""
+    out = np.empty(count, dtype=np.int64)
+    got = 0
+    pos = 0
+    byte_width = (bit_width + 7) // 8
+    while got < count and pos < len(buf):
+        # varint header
+        header = 0
+        shift = 0
+        while True:
+            b = buf[pos]
+            pos += 1
+            header |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        if header & 1:                       # bit-packed run
+            groups = header >> 1
+            nvals = groups * 8
+            nbytes = groups * bit_width
+            chunk = np.frombuffer(buf[pos:pos + nbytes], dtype=np.uint8)
+            pos += nbytes
+            bits = np.unpackbits(chunk, bitorder="little")
+            vals = bits.reshape(-1, bit_width)
+            weights = (1 << np.arange(bit_width)).astype(np.int64)
+            decoded = vals @ weights
+            take = min(nvals, count - got)
+            out[got:got + take] = decoded[:take]
+            got += take
+        else:                                # rle run
+            run = header >> 1
+            raw = buf[pos:pos + byte_width]
+            pos += byte_width
+            v = int.from_bytes(raw, "little") if byte_width else 0
+            take = min(run, count - got)
+            out[got:got + take] = v
+            got += take
+    return out
+
+
+# --------------------------------------------------------------------------
+# metadata model
+# --------------------------------------------------------------------------
+
+@dataclass
+class _ColumnInfo:
+    name: str
+    physical: int                # parquet Type enum
+    converted: Optional[int]
+    optional: bool
+    logical: Optional[dict] = None
+
+
+@dataclass
+class _ChunkInfo:
+    column: _ColumnInfo
+    codec: int
+    num_values: int
+    data_offset: int
+    dict_offset: Optional[int]
+
+
+@dataclass
+class ParquetMetadata:
+    num_rows: int
+    columns: List[_ColumnInfo]
+    row_groups: List[List[_ChunkInfo]]   # per group, per column
+
+
+_PHYS_BOOLEAN, _PHYS_INT32, _PHYS_INT64, _PHYS_INT96 = 0, 1, 2, 3
+_PHYS_FLOAT, _PHYS_DOUBLE, _PHYS_BYTE_ARRAY, _PHYS_FIXED = 4, 5, 6, 7
+
+
+def _sql_type(c: _ColumnInfo) -> Type:
+    if c.physical == _PHYS_BOOLEAN:
+        return BOOLEAN
+    if c.physical == _PHYS_INT32:
+        if c.converted == 6:                 # DATE
+            return DATE
+        return INTEGER
+    if c.physical == _PHYS_INT64:
+        if c.converted in (9, 10):           # TIMESTAMP_MILLIS/MICROS
+            return TimestampType(3)
+        if c.logical is not None and 8 in c.logical:
+            return TimestampType(3)          # logicalType TIMESTAMP
+        return BIGINT
+    if c.physical == _PHYS_FLOAT:
+        return REAL
+    if c.physical == _PHYS_DOUBLE:
+        return DOUBLE
+    if c.physical == _PHYS_BYTE_ARRAY:
+        return VarcharType(None)
+    raise ValueError(f"parquet physical type {c.physical} unsupported "
+                     f"for column {c.name}")
+
+
+def read_metadata(path: str) -> ParquetMetadata:
+    """Footer parse (reference: trino-parquet MetadataReader.java)."""
+    with open(path, "rb") as f:
+        data = f.read()
+    if data[:4] != MAGIC or data[-4:] != MAGIC:
+        raise ValueError(f"{path}: not a parquet file")
+    flen = int.from_bytes(data[-8:-4], "little")
+    footer = _TReader(data[len(data) - 8 - flen:len(data) - 8]).struct()
+    schema = footer[2]
+    cols: List[_ColumnInfo] = []
+    # schema[0] is the root group; flat children follow
+    for el in schema[1:]:
+        if el.get(5):                        # num_children -> nested
+            raise ValueError("nested parquet schemas are not supported")
+        cols.append(_ColumnInfo(
+            name=el[4].decode(),
+            physical=el.get(1, 0),
+            converted=el.get(6),
+            optional=el.get(3, 0) == 1,
+            logical=el.get(10)))
+    groups: List[List[_ChunkInfo]] = []
+    for rg in footer[4]:
+        chunks: List[_ChunkInfo] = []
+        for i, cc in enumerate(rg[1]):
+            md = cc[3]
+            chunks.append(_ChunkInfo(
+                column=cols[i],
+                codec=md.get(4, 0),
+                num_values=md[5],
+                data_offset=md[9],
+                dict_offset=md.get(11)))
+        groups.append(chunks)
+    return ParquetMetadata(footer[3], cols, groups)
+
+
+# --------------------------------------------------------------------------
+# column chunk reader
+# --------------------------------------------------------------------------
+
+_NP_FOR_PHYS = {
+    _PHYS_INT32: np.dtype("<i4"), _PHYS_INT64: np.dtype("<i8"),
+    _PHYS_FLOAT: np.dtype("<f4"), _PHYS_DOUBLE: np.dtype("<f8"),
+}
+
+
+def _plain_decode(phys: int, raw: bytes, n: int):
+    """PLAIN-encoded values -> (np array | list of bytes)."""
+    if phys in _NP_FOR_PHYS:
+        dt = _NP_FOR_PHYS[phys]
+        return np.frombuffer(raw[:n * dt.itemsize], dtype=dt).copy()
+    if phys == _PHYS_BOOLEAN:
+        bits = np.unpackbits(np.frombuffer(raw, dtype=np.uint8),
+                             bitorder="little")
+        return bits[:n].astype(bool)
+    if phys == _PHYS_BYTE_ARRAY:
+        out = []
+        pos = 0
+        for _ in range(n):
+            ln = int.from_bytes(raw[pos:pos + 4], "little")
+            pos += 4
+            out.append(raw[pos:pos + ln])
+            pos += ln
+        return out
+    raise ValueError(f"PLAIN decode for physical {phys} unsupported")
+
+
+def _read_chunk(data: bytes, chunk: _ChunkInfo) -> Tuple[list, list]:
+    """Read every page of one column chunk; returns (values, valid)
+    with values positionally dense (nulls hold placeholder)."""
+    col = chunk.column
+    dictionary = None
+    pos = chunk.dict_offset if chunk.dict_offset is not None \
+        else chunk.data_offset
+    values: List = []
+    valid: List[bool] = []
+    remaining = chunk.num_values
+    while remaining > 0:
+        rd = _TReader(data, pos)
+        header = rd.struct()
+        page_type = header[1]
+        comp_size = header[3]
+        uncomp_size = header[2]
+        body = data[rd.pos:rd.pos + comp_size]
+        pos = rd.pos + comp_size
+        if page_type == 2:                   # dictionary page
+            raw = _decompress(body, chunk.codec, uncomp_size)
+            dph = header[7]
+            dictionary = _plain_decode(col.physical, raw, dph[1])
+            continue
+        if page_type == 0:                   # data page v1
+            dp = header[5]
+            nvals = dp[1]
+            encoding = dp[2]
+            raw = _decompress(body, chunk.codec, uncomp_size)
+            off = 0
+            if col.optional:
+                dl_len = int.from_bytes(raw[off:off + 4], "little")
+                off += 4
+                levels = _read_rle_bitpacked(raw[off:off + dl_len], 1,
+                                             nvals)
+                off += dl_len
+                present = levels == 1
+            else:
+                present = np.ones(nvals, bool)
+        elif page_type == 3:                 # data page v2
+            dp = header[8]
+            nvals = dp[1]
+            encoding = dp[4]
+            dl_len = dp.get(5, 0)
+            rl_len = dp.get(6, 0)
+            lev = body[:rl_len + dl_len]
+            payload = body[rl_len + dl_len:]
+            if dp.get(7, True):
+                payload = _decompress(
+                    payload, chunk.codec,
+                    uncomp_size - rl_len - dl_len)
+            raw = payload
+            off = 0
+            if col.optional and dl_len:
+                levels = _read_rle_bitpacked(
+                    lev[rl_len:rl_len + dl_len], 1, nvals)
+                present = levels == 1
+            else:
+                present = np.ones(nvals, bool)
+        else:
+            raise ValueError(f"page type {page_type} unsupported")
+        ndef = int(present.sum())
+        if encoding == 0:                    # PLAIN
+            vals = _plain_decode(col.physical, raw[off:], ndef)
+        elif encoding in (2, 8):             # PLAIN_/RLE_DICTIONARY
+            bw = raw[off]
+            ids = _read_rle_bitpacked(raw[off + 1:], bw, ndef)
+            if dictionary is None:
+                raise ValueError("dictionary page missing")
+            if isinstance(dictionary, list):
+                vals = [dictionary[i] for i in ids]
+            else:
+                vals = dictionary[ids]
+        else:
+            raise ValueError(f"encoding {encoding} unsupported")
+        # scatter into row positions
+        it = iter(vals) if isinstance(vals, list) else None
+        vi = 0
+        for p in present:
+            if p:
+                values.append(next(it) if it is not None
+                              else vals[vi])
+                vi += 1
+            else:
+                values.append(None)
+            valid.append(bool(p))
+        remaining -= nvals
+    return values, valid
+
+
+def read_parquet(path: str,
+                 columns: Optional[Sequence[str]] = None,
+                 row_group: Optional[int] = None) -> Batch:
+    """Read a parquet file (or one row group) into a Batch."""
+    meta = read_metadata(path)
+    with open(path, "rb") as f:
+        data = f.read()
+    want = list(columns) if columns is not None \
+        else [c.name for c in meta.columns]
+    groups = meta.row_groups if row_group is None \
+        else [meta.row_groups[row_group]]
+    per_col: Dict[str, Tuple[list, list]] = \
+        {name: ([], []) for name in want}
+    for chunks in groups:
+        for chunk in chunks:
+            nm = chunk.column.name
+            if nm not in per_col:
+                continue
+            vals, valid = _read_chunk(data, chunk)
+            per_col[nm][0].extend(vals)
+            per_col[nm][1].extend(valid)
+    cols: Dict[str, Column] = {}
+    n = 0
+    for info in meta.columns:
+        if info.name not in per_col:
+            continue
+        vals, valid = per_col[info.name]
+        n = len(vals)
+        t = _sql_type(info)
+        cols[info.name] = _to_column(info, t, vals, valid)
+    out = Batch(cols, n)
+    return pad_batch(out, capacity_for(max(n, 1), minimum=8))
+
+
+def _to_column(info: _ColumnInfo, t: Type, vals: list,
+               valid: list) -> Column:
+    va = np.asarray(valid, bool)
+    if isinstance(t, VarcharType):
+        strings = [v.decode("utf-8", "replace")
+                   if isinstance(v, (bytes, bytearray)) else v
+                   for v in vals]
+        d, codes = StringDictionary.from_strings(strings)
+        return Column(t, codes, None if va.all() else va, d)
+    dt = t.np_dtype
+    data = np.zeros(len(vals), dtype=dt)
+    for i, v in enumerate(vals):
+        if v is not None:
+            data[i] = v
+    if t.name == "timestamp(3)" and info.converted == 10:
+        data //= 1000                        # micros -> millis
+    return Column(t, data, None if va.all() else va)
+
+
+def schema_of(path: str) -> Dict[str, Type]:
+    meta = read_metadata(path)
+    return {c.name: _sql_type(c) for c in meta.columns}
+
+
+def num_row_groups(path: str) -> int:
+    return len(read_metadata(path).row_groups)

@@ -24,7 +24,7 @@ AGGREGATE_NAMES = {
     "stddev_pop", "variance", "var_samp", "var_pop", "geometric_mean",
     "approx_distinct", "min_by", "max_by", "array_agg", "checksum",
     "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
-    "skewness", "kurtosis", "approx_percentile",
+    "skewness", "kurtosis", "approx_percentile", "map_agg", "histogram",
 }
 
 WINDOW_ONLY_NAMES = {
@@ -68,6 +68,12 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
     if name == "array_agg":
         from .types import ArrayType
         return ArrayType(t)
+    if name == "map_agg":
+        from .types import MapType
+        return MapType(arg_types[0], arg_types[1])
+    if name == "histogram":
+        from .types import MapType
+        return MapType(t, BIGINT)
     raise KeyError(f"unknown aggregate: {name}")
 
 
@@ -190,9 +196,50 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "to_hex": _varchar_fn,
     "from_hex": lambda n, a: VARCHAR,
     "xxhash64": _bigint_fn,
+    # bitwise (operator/scalar/BitwiseFunctions.java)
+    "bitwise_and": _bigint_fn, "bitwise_or": _bigint_fn,
+    "bitwise_xor": _bigint_fn, "bitwise_not": _bigint_fn,
+    "bitwise_left_shift": _bigint_fn,
+    "bitwise_right_shift": _bigint_fn,
+    "bit_count": _bigint_fn,
+    # digests (VarbinaryFunctions; ours return hex varchar)
+    "md5": _varchar_fn, "sha1": _varchar_fn, "sha256": _varchar_fn,
+    "sha512": _varchar_fn, "crc32": _bigint_fn,
+    # URL (operator/scalar/UrlFunctions.java)
+    "url_extract_protocol": _varchar_fn,
+    "url_extract_host": _varchar_fn,
+    "url_extract_port": _bigint_fn,
+    "url_extract_path": _varchar_fn,
+    "url_extract_query": _varchar_fn,
+    "url_extract_fragment": _varchar_fn,
+    "url_extract_parameter": _varchar_fn,
+    "url_encode": _varchar_fn, "url_decode": _varchar_fn,
+    "translate": _varchar_fn,
+    "log": _double_fn,
     # arrays (operator/scalar/ArrayFunctions + ArraySubscript)
     "cardinality": _bigint_fn,
     "element_at": lambda n, a: _array_elem(n, a),
+    "contains": lambda n, a: BOOLEAN,
+    "array_position": _bigint_fn,
+    "array_min": lambda n, a: _array_of(n, a).element,
+    "array_max": lambda n, a: _array_of(n, a).element,
+    "array_distinct": lambda n, a: _array_of(n, a),
+    "array_sort": lambda n, a: _array_of(n, a),
+    "array_join": _varchar_fn,
+    "slice": lambda n, a: _array_of(n, a),
+    "repeat": lambda n, a: _mk_array(a[0]),
+    "sequence": lambda n, a: _mk_array(a[0]),
+    "flatten": lambda n, a: _array_of(n, a).element,
+    "arrays_overlap": lambda n, a: BOOLEAN,
+    "array_union": lambda n, a: _common(n, a),
+    "array_intersect": lambda n, a: _common(n, a),
+    "array_except": lambda n, a: _common(n, a),
+    # maps (operator/scalar/MapFunctions.java etc.)
+    "map": lambda n, a: _map_ctor(n, a),
+    "map_keys": lambda n, a: _mk_array(_map_of(n, a).key),
+    "map_values": lambda n, a: _mk_array(_map_of(n, a).value),
+    "map_concat": _common,
+    "map_entries": lambda n, a: _map_entries(n, a),
     # JSON (operator/scalar/JsonFunctions.java)
     "json_extract_scalar": _varchar_fn,
     "json_extract": _varchar_fn,
@@ -202,11 +249,47 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
 
 
 def _array_elem(name, args):
-    from .types import ArrayType
+    from .types import ArrayType, MapType
+    if args and isinstance(args[0], MapType):
+        return args[0].value
     if not args or not isinstance(args[0], ArrayType):
         raise FunctionResolutionError(
             f"{name} requires an array argument")
     return args[0].element
+
+
+def _array_of(name, args):
+    from .types import ArrayType
+    if not args or not isinstance(args[0], ArrayType):
+        raise FunctionResolutionError(f"{name} requires an array")
+    return args[0]
+
+
+def _map_of(name, args):
+    from .types import MapType
+    if not args or not isinstance(args[0], MapType):
+        raise FunctionResolutionError(f"{name} requires a map")
+    return args[0]
+
+
+def _mk_array(t):
+    from .types import ArrayType
+    return ArrayType(t)
+
+
+def _map_ctor(name, args):
+    from .types import ArrayType, MapType
+    if (len(args) != 2 or not isinstance(args[0], ArrayType)
+            or not isinstance(args[1], ArrayType)):
+        raise FunctionResolutionError(
+            "map() takes two array arguments (keys, values)")
+    return MapType(args[0].element, args[1].element)
+
+
+def _map_entries(name, args):
+    from .types import ArrayType, RowType
+    m = _map_of(name, args)
+    return ArrayType(RowType([("key", m.key), ("value", m.value)]))
 
 
 def _err(name, args):

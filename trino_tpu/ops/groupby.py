@@ -474,6 +474,10 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
                       jnp.clip(start, 0, cap - 1), length > 0, None,
                       length, elements)
 
+    if agg.kind in ("map_agg", "histogram"):
+        return _resorted_agg(batch, agg, col, gid, live_s, gcap,
+                             key_lanes, extra_mask, order, live_u)
+
     raise ValueError(f"unknown aggregate kind {agg.kind}")
 
 
@@ -541,7 +545,7 @@ def _resorted_agg(batch: Batch, agg: AggInput, col: Column, gid, live_s,
             m = m & jnp.asarray(mcol.valid)
         valid_u = valid_u & m
 
-    if agg.kind == "count_distinct":
+    if agg.kind in ("count_distinct", "map_agg", "histogram"):
         vlanes = equality_lanes(col.data)
         if col.data2 is not None:
             vlanes = vlanes + equality_lanes(col.data2)
@@ -559,15 +563,46 @@ def _resorted_agg(batch: Batch, agg: AggInput, col: Column, gid, live_s,
         key_lanes, tie, live, gcap)
     valid2 = jnp.take(valid_u, order2)
 
-    if agg.kind == "count_distinct":
+    if agg.kind in ("count_distinct", "map_agg", "histogram"):
         changed_v = changed_k
         for lane in tie:
             s = jnp.take(lane, order2)
             changed_v = changed_v | (s != jnp.roll(s, 1))
         newval = (changed_v | first) & valid2
-        data = jax.ops.segment_sum(newval.astype(jnp.int64), gid2,
-                                   num_segments=gcap)
-        return Column(BIGINT, data, None)
+        if agg.kind == "count_distinct":
+            data = jax.ops.segment_sum(newval.astype(jnp.int64), gid2,
+                                       num_segments=gcap)
+            return Column(BIGINT, data, None)
+        # map_agg / histogram: each (group, distinct key) run is one
+        # map entry; runs are (group, key)-major so per-group entry
+        # ranges are contiguous (reference: operator/aggregation/
+        # MapAggregationFunction / histogram/Histogram.java)
+        from ..types import MapType
+        runid = jnp.clip(jnp.cumsum(newval.astype(jnp.int64)) - 1,
+                         0, cap - 1).astype(jnp.int32)
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        run_start = jax.ops.segment_min(
+            jnp.where(newval, pos, jnp.int64(cap)), runid,
+            num_segments=cap)
+        entry_rows = jnp.take(order2, jnp.clip(run_start, 0, cap - 1))
+        keys_pool = col.gather(entry_rows)
+        first_run = jax.ops.segment_min(
+            jnp.where(newval, runid.astype(jnp.int64), jnp.int64(cap)),
+            gid2, num_segments=gcap)
+        nentries = jax.ops.segment_sum(newval.astype(jnp.int64), gid2,
+                                       num_segments=gcap)
+        if agg.kind == "histogram":
+            counts = jax.ops.segment_sum(
+                valid2.astype(jnp.int64), runid, num_segments=cap)
+            vals_pool = Column(BIGINT, counts, None)
+            out_t = MapType(col.type, BIGINT)
+        else:
+            vcol = batch.column(agg.input2)
+            vals_pool = vcol.gather(entry_rows)
+            out_t = MapType(col.type, vcol.type)
+        return Column(out_t, jnp.clip(first_run, 0, cap - 1),
+                      nentries > 0, None, nentries, keys_pool,
+                      vals_pool)
 
     # exact percentile: valid rows of each group are a contiguous
     # ascending run starting at the group boundary (invalids sort last
@@ -713,6 +748,44 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             out[agg.output] = Column(
                 ArrayType(col.type), jnp.zeros((1,), jnp.int64),
                 (n_inc > 0)[None], None, n_inc[None], elements)
+        elif agg.kind in ("map_agg", "histogram"):
+            from ..types import MapType
+            vlanes = equality_lanes(col.data)
+            if col.data2 is not None:
+                vlanes = vlanes + equality_lanes(col.data2)
+            vlanes = [jnp.where(valid, u, jnp.zeros_like(u))
+                      for u in vlanes]
+            full = [(~valid).astype(jnp.uint64)] + vlanes
+            order2 = jnp.lexsort(full[::-1])
+            valid2 = jnp.take(valid, order2)
+            cap = batch.capacity
+            changed = jnp.arange(cap) == 0
+            for lane in vlanes:
+                s = jnp.take(lane, order2)
+                changed = changed | (s != jnp.roll(s, 1))
+            newent = (changed | (jnp.arange(cap) == 0)) & valid2
+            runid = jnp.clip(jnp.cumsum(newent.astype(jnp.int64)) - 1,
+                             0, cap - 1).astype(jnp.int32)
+            pos = jnp.arange(cap, dtype=jnp.int64)
+            run_start = jax.ops.segment_min(
+                jnp.where(newent, pos, jnp.int64(cap)), runid,
+                num_segments=cap)
+            entry_rows = jnp.take(order2,
+                                  jnp.clip(run_start, 0, cap - 1))
+            keys_pool = col.gather(entry_rows)
+            nent = jnp.sum(newent.astype(jnp.int64))
+            if agg.kind == "histogram":
+                counts = jax.ops.segment_sum(
+                    valid2.astype(jnp.int64), runid, num_segments=cap)
+                vals_pool = Column(BIGINT, counts, None)
+                out_t = MapType(col.type, BIGINT)
+            else:
+                vcol = batch.column(agg.input2)
+                vals_pool = vcol.gather(entry_rows)
+                out_t = MapType(col.type, vcol.type)
+            out[agg.output] = Column(
+                out_t, jnp.zeros((1,), jnp.int64), (nent > 0)[None],
+                None, nent[None], keys_pool, vals_pool)
         elif agg.kind == "percentile":
             from dataclasses import replace as _replace
             if col.data2 is not None:

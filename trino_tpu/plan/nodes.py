@@ -351,6 +351,10 @@ class WindowFunction:
     frame_end: str = "current"
     offset: Optional[str] = None     # lag/lead offset symbol
     default: Optional[str] = None    # lag/lead default symbol
+    # constant offsets for '<n> PRECEDING/FOLLOWING' frame bounds
+    # (operator/window/FrameInfo.java)
+    frame_start_value: Optional[int] = None
+    frame_end_value: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -504,8 +508,13 @@ def plan_tree_lines(node: PlanNode, indent: int = 0) -> List[str]:
     name = type(node).__name__.replace("Node", "")
     detail = ""
     if isinstance(node, TableScanNode):
+        extras = ""
+        if getattr(node.handle, "constraint", None) is not None:
+            extras += f" constraint=({node.handle.constraint})"
+        if getattr(node.handle, "limit", None) is not None:
+            extras += f" limit={node.handle.limit}"
         detail = (f"[{node.handle.catalog}.{node.handle.schema}."
-                  f"{node.handle.table}]")
+                  f"{node.handle.table}{extras}]")
     elif isinstance(node, FilterNode):
         detail = f"[{node.predicate}]"
     elif isinstance(node, ProjectNode):

@@ -35,7 +35,8 @@ from ..plan.nodes import (Aggregate, AggregationNode, AssignUniqueIdNode,
                           SemiJoinNode, SetOpNode, SortKey, SortNode,
                           TableScanNode, TopNNode, UnionNode, UnnestNode,
                           ValuesNode, WindowFunction, WindowNode)
-from ..rex import Call, CaseExpr, Cast, Const, InputRef, RowExpr, TRUE
+from ..rex import (Call, CaseExpr, Cast, Const, InputRef, Lambda, RowExpr,
+                   TRUE)
 from ..session import Session
 from ..sql import ast as A
 from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN,
@@ -484,7 +485,8 @@ class LogicalPlanner:
                         param = float(a1.value)
                     elif kind in ("min_by", "max_by", "corr",
                                   "covar_samp", "covar_pop",
-                                  "regr_slope", "regr_intercept"):
+                                  "regr_slope", "regr_intercept",
+                                  "map_agg"):
                         a1 = args[1]
                         if isinstance(a1, InputRef):
                             arg2_sym = a1.name
@@ -660,6 +662,17 @@ class LogicalPlanner:
                 full.update(pre)
                 root = ProjectNode(root, full)
             frame = spec.frame
+
+            def frame_const(value_expr, what):
+                if value_expr is None:
+                    return None
+                v = self._const_expr(value_expr).value
+                if v is None or int(v) < 0:
+                    raise PlanningError(
+                        f"window frame {what} offset must be a "
+                        "non-negative constant")
+                return int(v)
+
             out_sym = self.symbols.new(call.name)
             fn = WindowFunction(
                 call.name, arg_sym, rtype,
@@ -667,7 +680,11 @@ class LogicalPlanner:
                 frame_start=frame.start_type if frame
                 else "unbounded_preceding",
                 frame_end=frame.end_type if frame else "current",
-                offset=off_sym, default=def_sym)
+                offset=off_sym, default=def_sym,
+                frame_start_value=frame_const(
+                    frame.start_value if frame else None, "start"),
+                frame_end_value=frame_const(
+                    frame.end_value if frame else None, "end"))
             root = WindowNode(root, part, order, {out_sym: fn})
             win_map[call] = (out_sym, rtype)
         out = _ExprContext(self, ctx.scope, root, agg_map=ctx.agg_map,
@@ -722,6 +739,34 @@ class LogicalPlanner:
         catalog, schema, table = self._qualify(parts)
         if schema == "information_schema":
             return self._plan_information_schema(catalog, table, outer)
+        view = self.catalogs.get_view(catalog, schema, table)
+        if view is not None:
+            # view expansion: plan the stored definition in place
+            # (reference: StatementAnalyzer visitTable view branch,
+            # with the analyzer's recursive-view detection)
+            key = (catalog, schema, table)
+            stack = getattr(self, "_view_stack", None)
+            if stack is None:
+                stack = self._view_stack = []
+            if key in stack:
+                raise PlanningError(
+                    "View is recursive: " + ".".join(key))
+            stack.append(key)
+            try:
+                rp, names = self.plan_query(view.query)
+            finally:
+                stack.pop()
+            fields = [Field(f.name, f.symbol, f.type, table)
+                      for f in rp.scope.fields]
+            return RelationPlan(rp.root, Scope(fields, outer))
+        ac = self.catalogs.access_control
+        if ac is not None:
+            from ..security import AccessDeniedError
+            try:
+                ac.check_can_select(self.session.user, catalog, schema,
+                                    table)
+            except AccessDeniedError as e:
+                raise PlanningError(str(e)) from e
         handle, meta = self.catalogs.resolve_table(catalog, schema, table)
         assignments, schema_map, fields = {}, {}, []
         for cm in meta.columns:
@@ -765,6 +810,11 @@ class LogicalPlanner:
             cols = [("table_catalog", VARCHAR), ("table_schema", VARCHAR),
                     ("table_name", VARCHAR), ("view_definition", VARCHAR)]
             rows = []
+            for s in conn.list_schemas():
+                for v in self.catalogs.list_views(catalog, s):
+                    vd = self.catalogs.get_view(catalog, s, v)
+                    rows.append((catalog, s, v,
+                                 vd.sql if vd is not None else None))
         else:
             raise PlanningError(
                 f"Table '{catalog}.information_schema.{table}' does not "
@@ -1191,6 +1241,10 @@ class _ExprContext:
         self.group_symbols = group_symbols
         self.win_map: Dict[A.Expression, Tuple[str, Type]] = {}
         self.in_aggregate = False
+        # lambda parameter bindings: name -> (synthetic symbol, type);
+        # pushed/popped around lambda-body rewriting (reference:
+        # ExpressionAnalyzer lambda scopes)
+        self.lambda_params: Dict[str, Tuple[str, Type]] = {}
 
     def rewrite(self, e: A.Expression) -> RowExpr:
         return self.planner._rewrite_expr(e, self)
@@ -1224,7 +1278,17 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
     if isinstance(e, A.IntervalLiteral):
         return _plan_interval(e)
     if isinstance(e, A.Identifier):
-        f, is_outer = ctx.scope.resolve(e.parts)
+        if len(e.parts) == 1 and e.parts[0] in ctx.lambda_params:
+            sym, t = ctx.lambda_params[e.parts[0]]
+            return InputRef(sym, t)
+        try:
+            f, is_outer = ctx.scope.resolve(e.parts)
+        except PlanningError:
+            # row-field dereference: a.b where a is a row-typed column
+            deref = _try_row_dereference(self, e, ctx)
+            if deref is not None:
+                return deref
+            raise
         ref = InputRef(f.symbol, f.type)
         if not is_outer and ctx.group_symbols is not None \
                 and not ctx.in_aggregate \
@@ -1341,10 +1405,34 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
             t = nt
         items = [_maybe_cast(i, t) for i in items]
         return Call("$array", tuple(items), ArrayType(t))
+    if isinstance(e, A.RowConstructor):
+        from ..types import RowType
+        items = [self._rewrite_expr(i, ctx) for i in e.items]
+        t = RowType([(None, i.type) for i in items])
+        return Call("$row", tuple(items), t)
+    if isinstance(e, A.LambdaExpression):
+        raise PlanningError(
+            "lambda expressions are only valid as arguments of "
+            "higher-order functions (transform, filter, reduce, ...)")
     if isinstance(e, A.Subscript):
-        from ..types import ArrayType
+        from ..types import ArrayType, MapType, RowType
         base = self._rewrite_expr(e.base, ctx)
         idx = self._rewrite_expr(e.index, ctx)
+        if isinstance(base.type, MapType):
+            # m[k]: missing key yields NULL (element_at semantics; the
+            # reference's strict m[k] raise cannot surface from a
+            # compiled whole-column program)
+            key = _maybe_cast(idx, base.type.key)
+            return Call("element_at", (base, key), base.type.value)
+        if isinstance(base.type, RowType):
+            if not (isinstance(idx, Const) and idx.value is not None):
+                raise PlanningError(
+                    "ROW subscript must be a constant")
+            i = int(idx.value)
+            if not (1 <= i <= len(base.type.fields)):
+                raise PlanningError(f"ROW subscript out of range: {i}")
+            return Call("$field", (base, Const(i - 1, BIGINT)),
+                        base.type.fields[i - 1][1])
         if not isinstance(base.type, ArrayType):
             raise PlanningError(
                 f"subscript requires an array (got {base.type})")
@@ -1367,12 +1455,173 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
 LogicalPlanner._rewrite_expr = _rewrite_expr
 
 
+def _try_row_dereference(self: LogicalPlanner, e: A.Identifier,
+                         ctx: _ExprContext):
+    """Resolve a.b / t.a.b where the prefix is a ROW-typed column and the
+    suffix names fields (reference: ExpressionAnalyzer dereference
+    resolution, sql/planner/iterative/rule/PushDownDereference*)."""
+    from ..types import RowType
+    parts = e.parts
+    for cut in range(len(parts) - 1, 0, -1):
+        base = None
+        prefix = parts[:cut]
+        if len(prefix) == 1 and prefix[0] in ctx.lambda_params:
+            sym, t = ctx.lambda_params[prefix[0]]
+            base = InputRef(sym, t)
+        else:
+            f, _ = ctx.scope.try_resolve(prefix)
+            if f is not None:
+                base = InputRef(f.symbol, f.type)
+        if base is None:
+            continue
+        expr = base
+        ok = True
+        for fld in parts[cut:]:
+            if not isinstance(expr.type, RowType):
+                ok = False
+                break
+            idx = None
+            for i, (fn, ft) in enumerate(expr.type.fields):
+                if fn is not None and fn.lower() == fld.lower():
+                    idx = i
+                    break
+            if idx is None:
+                ok = False
+                break
+            expr = Call("$field", (expr, Const(idx, BIGINT)),
+                        expr.type.fields[idx][1])
+        if ok:
+            return expr
+    return None
+
+
+# higher-order (lambda-taking) functions and the positions of their
+# lambda arguments (reference: operator/scalar/ArrayTransformFunction
+# and friends, SURVEY.md Appendix A.10)
+_HIGHER_ORDER = {"transform", "filter", "reduce", "any_match",
+                 "all_match", "none_match", "zip_with", "map_filter",
+                 "transform_keys", "transform_values", "map_zip_with"}
+
+
+def _plan_lambda(self: LogicalPlanner, lam: A.LambdaExpression,
+                 ctx: _ExprContext, param_types) -> Lambda:
+    if len(lam.params) != len(param_types):
+        raise PlanningError(
+            f"lambda has {len(lam.params)} parameters, expected "
+            f"{len(param_types)}")
+    saved = dict(ctx.lambda_params)
+    syms = []
+    for p, t in zip(lam.params, param_types):
+        sym = self.symbols.new("lam_" + p)
+        ctx.lambda_params[p] = (sym, t)
+        syms.append(sym)
+    try:
+        body = self._rewrite_expr(lam.body, ctx)
+    finally:
+        ctx.lambda_params.clear()
+        ctx.lambda_params.update(saved)
+    return Lambda(tuple(syms), body, body.type)
+
+
+def _plan_higher_order(self: LogicalPlanner, e: A.FunctionCall,
+                       ctx: _ExprContext) -> RowExpr:
+    from ..types import ArrayType, BOOLEAN as _B, MapType
+    name = e.name
+
+    def arr_of(i):
+        a = self._rewrite_expr(e.args[i], ctx)
+        if not isinstance(a.type, ArrayType):
+            raise PlanningError(f"{name} argument {i + 1} must be an "
+                                f"array (got {a.type})")
+        return a
+
+    def map_of(i):
+        m = self._rewrite_expr(e.args[i], ctx)
+        if not isinstance(m.type, MapType):
+            raise PlanningError(f"{name} argument {i + 1} must be a map "
+                                f"(got {m.type})")
+        return m
+
+    def lam(i, ptypes):
+        a = e.args[i]
+        if not isinstance(a, A.LambdaExpression):
+            raise PlanningError(
+                f"{name} argument {i + 1} must be a lambda")
+        return _plan_lambda(self, a, ctx, ptypes)
+
+    if name == "transform":
+        a = arr_of(0)
+        fn = lam(1, [a.type.element])
+        return Call(name, (a, fn), ArrayType(fn.type))
+    if name == "filter":
+        a = arr_of(0)
+        fn = lam(1, [a.type.element])
+        _require_boolean(fn.body, "filter lambda")
+        return Call(name, (a, fn), a.type)
+    if name in ("any_match", "all_match", "none_match"):
+        a = arr_of(0)
+        fn = lam(1, [a.type.element])
+        _require_boolean(fn.body, f"{name} lambda")
+        return Call(name, (a, fn), BOOLEAN)
+    if name == "reduce":
+        a = arr_of(0)
+        init = self._rewrite_expr(e.args[1], ctx)
+        step = lam(2, [init.type, a.type.element])
+        state_t = common_super_type(init.type, step.type) or step.type
+        if state_t != step.type:
+            # re-plan the step with the widened state type
+            step = lam(2, [state_t, a.type.element])
+        out = lam(3, [state_t])
+        return Call(name, (a, _maybe_cast(init, state_t), step, out),
+                    out.type)
+    if name == "zip_with":
+        a, b = arr_of(0), arr_of(1)
+        fn = lam(2, [a.type.element, b.type.element])
+        return Call(name, (a, b, fn), ArrayType(fn.type))
+    if name == "map_filter":
+        m = map_of(0)
+        fn = lam(1, [m.type.key, m.type.value])
+        _require_boolean(fn.body, "map_filter lambda")
+        return Call(name, (m, fn), m.type)
+    if name == "transform_keys":
+        m = map_of(0)
+        fn = lam(1, [m.type.key, m.type.value])
+        return Call(name, (m, fn), MapType(fn.type, m.type.value))
+    if name == "transform_values":
+        m = map_of(0)
+        fn = lam(1, [m.type.key, m.type.value])
+        return Call(name, (m, fn), MapType(m.type.key, fn.type))
+    if name == "map_zip_with":
+        m1, m2 = map_of(0), map_of(1)
+        k = common_super_type(m1.type.key, m2.type.key)
+        if k is None:
+            raise PlanningError("map_zip_with keys are incompatible")
+        fn = lam(2, [k, m1.type.value, m2.type.value])
+        return Call(name, (m1, m2, fn), MapType(k, fn.type))
+    raise PlanningError(f"unsupported higher-order function {name}")
+
+
 def _plan_function(self: LogicalPlanner, e: A.FunctionCall,
                    ctx: _ExprContext) -> RowExpr:
     name = e.name
     if e.window is not None:
         raise PlanningError(
             f"window function '{name}' used outside SELECT list")
+    if name == "$field":
+        # parser-desugared row dereference on a non-identifier base
+        from ..types import RowType
+        base = self._rewrite_expr(e.args[0], ctx)
+        fld = e.args[1].value
+        if not isinstance(base.type, RowType):
+            raise PlanningError(
+                f"cannot dereference .{fld} on {base.type}")
+        for i, (fn_, ft) in enumerate(base.type.fields):
+            if fn_ is not None and fn_.lower() == str(fld).lower():
+                return Call("$field", (base, Const(i, BIGINT)), ft)
+        raise PlanningError(f"row has no field named '{fld}'")
+    if name in _HIGHER_ORDER and any(
+            isinstance(a, A.LambdaExpression) for a in e.args):
+        return _plan_higher_order(self, e, ctx)
     if is_aggregate(name):
         if ctx.group_symbols is None and not ctx.agg_map:
             raise PlanningError(

@@ -38,16 +38,110 @@ def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
         from .stats import choose_join_sides, reorder_joins
         force = "AUTOMATIC"
         reorder = "AUTOMATIC"
+        pushdown = True
         if session is not None:
             force = session.get("join_distribution_type") or "AUTOMATIC"
             reorder = (session.get("join_reordering_strategy")
                        or "AUTOMATIC")
+            pushdown = bool(session.get("pushdown_into_scan"))
         if str(reorder).upper() != "NONE":
             plan = reorder_joins(plan, catalogs)
         plan = choose_join_sides(plan, catalogs, force)
+        if pushdown:
+            plan = push_into_scan(plan, catalogs)
     plan = prune_columns(plan)
     plan = cleanup_projects(plan)
     return plan
+
+
+# --------------------------------------------------------------------------
+# connector pushdown (PushPredicateIntoTableScan / PushLimitIntoTableScan)
+# --------------------------------------------------------------------------
+
+def _domain_pushable(t) -> bool:
+    """Types whose plan-constant values compare 1:1 against the
+    connector's host lanes (predicate.filter_batch_host): integrals,
+    date, bool, float, dictionary strings. DECIMAL consts are strings
+    at plan time — skip."""
+    from ..types import DecimalType, is_string
+    if isinstance(t, DecimalType):
+        return False
+    return t.name in ("tinyint", "smallint", "integer", "bigint",
+                      "real", "double", "date", "boolean") \
+        or is_string(t)
+
+
+def push_into_scan(node: PlanNode, catalogs) -> PlanNode:
+    """Offer filter domains and limits to connectors
+    (sql/planner/iterative/rule/PushPredicateIntoTableScan.java,
+    PushLimitIntoTableScan.java). Accepted domains are baked into the
+    TableHandle; fully-enforced conjuncts leave the plan."""
+    from ..predicate import TupleDomain, extract_tuple_domain
+
+    if isinstance(node, FilterNode) and \
+            isinstance(node.source, TableScanNode):
+        scan = node.source
+        ok_syms = {sym: scan.schema[sym]
+                   for sym in scan.assignments
+                   if _domain_pushable(scan.schema[sym])}
+        td_sym, residual = extract_tuple_domain(node.predicate, ok_syms)
+        if not td_sym.is_all():
+            td_conn = TupleDomain(
+                tuple((scan.assignments[sym], dom)
+                      for sym, dom in td_sym.domains), td_sym.is_none)
+            conn = catalogs.connector(scan.handle.catalog)
+            got = conn.apply_filter(scan.handle, td_conn)
+            if got is not None:
+                new_handle, fully = got
+                new_scan = dc_replace(scan, handle=new_handle)
+                if fully and not residual:
+                    return new_scan
+                pred = rex.and_all(residual) if fully else node.predicate
+                return FilterNode(new_scan, pred)
+        return node
+
+    if isinstance(node, LimitNode):
+        # limit commutes with row-preserving projections
+        # (PushLimitThroughProject + PushLimitIntoTableScan)
+        below = node.source
+        projs = []
+        while isinstance(below, ProjectNode):
+            projs.append(below)
+            below = below.source
+        if isinstance(below, TableScanNode):
+            conn = catalogs.connector(below.handle.catalog)
+            got = conn.apply_limit(below.handle, node.count)
+            if got is not None:
+                rebuilt: PlanNode = dc_replace(below, handle=got)
+                for p in reversed(projs):
+                    rebuilt = dc_replace(p, source=rebuilt)
+                return dc_replace(node, source=rebuilt)
+        return _replace_sources(
+            node, [push_into_scan(node.source, catalogs)])
+
+    srcs = getattr(node, "sources", ())
+    if not srcs:
+        return node
+    new_srcs = [push_into_scan(s, catalogs) for s in srcs]
+    if all(a is b for a, b in zip(new_srcs, srcs)):
+        return node
+    return _replace_sources(node, new_srcs)
+
+
+def _replace_sources(node: PlanNode, new_sources) -> PlanNode:
+    """Rebuild a node with new child nodes, mapping them back onto the
+    dataclass fields in ``sources`` order."""
+    import dataclasses
+    it = iter(new_sources)
+    changes = {}
+    for f in dataclasses.fields(node):
+        v = getattr(node, f.name)
+        if isinstance(v, PlanNode):
+            changes[f.name] = next(it)
+        elif isinstance(v, tuple) and v and \
+                all(isinstance(x, PlanNode) for x in v):
+            changes[f.name] = tuple(next(it) for _ in v)
+    return dc_replace(node, **changes)
 
 
 # --------------------------------------------------------------------------

@@ -81,6 +81,20 @@ class CaseExpr(RowExpr):
         return f"case {parts} else {self.default} end"
 
 
+@dataclass(frozen=True)
+class Lambda(RowExpr):
+    """Lambda argument of a higher-order function (reference:
+    sql/relational/LambdaDefinitionExpression). ``params`` are fresh
+    symbol names the body refers to via InputRef; the evaluator binds
+    them to flat element lanes (exec/expr.py lambda machinery)."""
+    params: Tuple[str, ...]
+    body: RowExpr
+    type: Type  # the body's result type
+
+    def __str__(self):
+        return f"({', '.join(self.params)}) -> {self.body}"
+
+
 TRUE = Const(True, BOOLEAN)
 FALSE = Const(False, BOOLEAN)
 
@@ -111,6 +125,8 @@ def walk(e: RowExpr):
     if isinstance(e, Call):
         for a in e.args:
             yield from walk(a)
+    elif isinstance(e, Lambda):
+        yield from walk(e.body)
     elif isinstance(e, Cast):
         yield from walk(e.arg)
     elif isinstance(e, CaseExpr):
@@ -122,7 +138,29 @@ def walk(e: RowExpr):
 
 
 def input_names(e: RowExpr):
-    return {x.name for x in walk(e) if isinstance(x, InputRef)}
+    """Free InputRef names (lambda parameters are bound, not inputs)."""
+    out = set()
+
+    def go(x, bound):
+        if isinstance(x, InputRef):
+            if x.name not in bound:
+                out.add(x.name)
+        elif isinstance(x, Call):
+            for a in x.args:
+                go(a, bound)
+        elif isinstance(x, Lambda):
+            go(x.body, bound | set(x.params))
+        elif isinstance(x, Cast):
+            go(x.arg, bound)
+        elif isinstance(x, CaseExpr):
+            for c, v in x.whens:
+                go(c, bound)
+                go(v, bound)
+            if x.default is not None:
+                go(x.default, bound)
+
+    go(e, frozenset())
+    return out
 
 
 def replace_inputs(e: RowExpr, mapping) -> RowExpr:
@@ -135,6 +173,9 @@ def replace_inputs(e: RowExpr, mapping) -> RowExpr:
     if isinstance(e, Call):
         return Call(e.fn, tuple(replace_inputs(a, mapping) for a in e.args),
                     e.type)
+    if isinstance(e, Lambda):
+        inner = {k: v for k, v in mapping.items() if k not in e.params}
+        return Lambda(e.params, replace_inputs(e.body, inner), e.type)
     if isinstance(e, Cast):
         return Cast(replace_inputs(e.arg, mapping), e.type, e.safe)
     if isinstance(e, CaseExpr):

@@ -69,8 +69,14 @@ class LocalQueryRunner:
                 self.catalogs.register("tpcds", TpcdsConnector())
             self.catalogs.register("memory", MemoryConnector())
             self.catalogs.register("blackhole", BlackholeConnector())
+            from .connectors.system import SystemConnector
+            self.catalogs.register("system", SystemConnector())
         self.session = session or Session(catalog="tpch", schema="tiny")
         self.mesh = mesh
+        # engine transaction state (reference:
+        # transaction/InMemoryTransactionManager — per-catalog
+        # copy-on-begin, restore-on-rollback)
+        self._txn_snapshot = None
         if distributed and self.mesh is None:
             from .parallel.mesh import get_mesh
             self.mesh = get_mesh(n_devices)
@@ -91,7 +97,7 @@ class LocalQueryRunner:
             raise QueryError(f"SYNTAX_ERROR: {e}") from e
         qid = self.session.next_query_id()
         try:
-            result = self._dispatch(stmt)
+            result = self._dispatch(stmt, sql)
         except PlanningError as e:
             raise QueryError(str(e)) from e
         except KeyError as e:
@@ -134,9 +140,94 @@ class LocalQueryRunner:
             if optimized else plan
 
     # ------------------------------------------------------------------
-    def _dispatch(self, stmt: A.Statement) -> QueryResult:
+    def _dispatch(self, stmt: A.Statement, sql: str = "") -> QueryResult:
         if isinstance(stmt, A.QueryStatement):
             return self._run_query(stmt)
+        if isinstance(stmt, A.CreateView):
+            return self._create_view(stmt, sql)
+        if isinstance(stmt, A.DropView):
+            cat, schema, name = self._qualify(stmt.name)
+            if not self.catalogs.drop_view(cat, schema, name) \
+                    and not stmt.if_exists:
+                raise QueryError(
+                    f"View '{cat}.{schema}.{name}' does not exist")
+            return _msg_result("DROP VIEW")
+        if isinstance(stmt, A.ShowCreate):
+            return self._show_create(stmt)
+        if isinstance(stmt, A.Describe):
+            return self._dispatch(A.ShowColumns(stmt.table))
+        if isinstance(stmt, A.Prepare):
+            self.session.prepared[stmt.name] = stmt.statement
+            return _msg_result("PREPARE")
+        if isinstance(stmt, A.Deallocate):
+            if stmt.name not in self.session.prepared:
+                raise QueryError(
+                    f"Prepared statement not found: {stmt.name}")
+            del self.session.prepared[stmt.name]
+            return _msg_result("DEALLOCATE")
+        if isinstance(stmt, A.ExecuteStmt):
+            return self._execute_prepared(stmt)
+        if isinstance(stmt, A.DescribeInput):
+            prep = self.session.prepared.get(stmt.name)
+            if prep is None:
+                raise QueryError(
+                    f"Prepared statement not found: {stmt.name}")
+            n = A.count_parameters(prep)
+            return QueryResult(["Position", "Type"], [BIGINT, VARCHAR],
+                               [[i, "unknown"] for i in range(n)])
+        if isinstance(stmt, A.DescribeOutput):
+            prep = self.session.prepared.get(stmt.name)
+            if prep is None:
+                raise QueryError(
+                    f"Prepared statement not found: {stmt.name}")
+            if not isinstance(prep, A.QueryStatement):
+                return QueryResult(["Column Name", "Type"],
+                                   [VARCHAR, VARCHAR], [])
+            # bind dummy NULLs for parameters so the query plans
+            n = A.count_parameters(prep)
+            bound, _ = A.replace_parameters(
+                prep, [A.Literal(None)] * n)
+            planner = LogicalPlanner(self.catalogs, self.session)
+            plan = planner.plan(bound)
+            schema = plan.output_schema()
+            return QueryResult(
+                ["Column Name", "Type"], [VARCHAR, VARCHAR],
+                [[name, str(schema[s])]
+                 for name, s in zip(plan.names, plan.symbols)])
+        if isinstance(stmt, A.CallStatement):
+            parts = tuple(p.lower() for p in stmt.name)
+            if len(parts) != 3:
+                raise QueryError(
+                    "CALL requires catalog.schema.procedure")
+            cat, schema, proc = parts
+            planner = LogicalPlanner(self.catalogs, self.session)
+            args = [planner._const_expr(a).value for a in stmt.args]
+            conn = self.catalogs.connector(cat)
+            try:
+                conn.call_procedure(schema, proc, args)
+            except (KeyError, ValueError) as e:
+                raise QueryError(str(e).strip('"')) from e
+            return _msg_result("CALL")
+        if isinstance(stmt, A.StartTransaction):
+            if self._txn_snapshot is not None:
+                raise QueryError("Nested transactions not supported")
+            self._txn_snapshot = {
+                name: self.catalogs.connector(name).snapshot_state()
+                for name in self.catalogs.list_catalogs()}
+            return _msg_result("START TRANSACTION")
+        if isinstance(stmt, A.Commit):
+            if self._txn_snapshot is None:
+                raise QueryError("No transaction in progress")
+            self._txn_snapshot = None
+            return _msg_result("COMMIT")
+        if isinstance(stmt, A.Rollback):
+            if self._txn_snapshot is None:
+                raise QueryError("No transaction in progress")
+            for name, snap in self._txn_snapshot.items():
+                if snap is not None:
+                    self.catalogs.connector(name).restore_state(snap)
+            self._txn_snapshot = None
+            return _msg_result("ROLLBACK")
         if isinstance(stmt, A.Explain):
             return self._explain(stmt)
         if isinstance(stmt, A.UseStatement):
@@ -171,7 +262,9 @@ class LocalQueryRunner:
                 else:
                     schema = parts[0]
             conn = self.catalogs.connector(cat)
-            tables = conn.list_tables(schema)
+            tables = sorted(set(conn.list_tables(schema))
+                            | set(self.catalogs.list_views(cat,
+                                                           schema)))
             if stmt.like:
                 import re
                 from .exec.expr import like_to_regex
@@ -200,6 +293,7 @@ class LocalQueryRunner:
             return self._create_table(stmt)
         if isinstance(stmt, A.DropTable):
             cat, schema, table = self._qualify(stmt.name)
+            self._check_access("drop_table", cat, schema, table)
             conn = self.catalogs.connector(cat)
             if conn.get_table_metadata(schema, table) is None:
                 if stmt.if_exists:
@@ -251,8 +345,66 @@ class LocalQueryRunner:
         return QueryResult(["Query Plan"], [VARCHAR],
                            [[l] for l in plan_tree_lines(plan)])
 
+    def _create_view(self, stmt: A.CreateView, sql: str) -> QueryResult:
+        from .catalog import ViewDefinition
+        cat, schema, name = self._qualify(stmt.name)
+        self.catalogs.connector(cat)  # validate catalog
+        # validate the definition by planning it now (reference:
+        # CreateViewTask analyzes the view query)
+        planner = LogicalPlanner(self.catalogs, self.session)
+        planner.plan(A.QueryStatement(stmt.query))
+        try:
+            self.catalogs.create_view(
+                cat, schema, name, ViewDefinition(stmt.query, sql),
+                replace=stmt.replace)
+        except KeyError as e:
+            raise QueryError(str(e).strip('"')) from e
+        return _msg_result("CREATE VIEW")
+
+    def _show_create(self, stmt: A.ShowCreate) -> QueryResult:
+        cat, schema, name = self._qualify(stmt.name)
+        if stmt.kind == "view":
+            view = self.catalogs.get_view(cat, schema, name)
+            if view is None:
+                raise QueryError(
+                    f"View '{cat}.{schema}.{name}' does not exist")
+            return QueryResult(["Create View"], [VARCHAR],
+                               [[view.sql or f"CREATE VIEW "
+                                 f"{cat}.{schema}.{name} AS ..."]])
+        conn = self.catalogs.connector(cat)
+        meta = conn.get_table_metadata(schema, name)
+        if meta is None:
+            raise QueryError(
+                f"Table '{cat}.{schema}.{name}' does not exist")
+        cols = ",\n   ".join(f"{c.name} {c.type}" for c in meta.columns)
+        return QueryResult(
+            ["Create Table"], [VARCHAR],
+            [[f"CREATE TABLE {cat}.{schema}.{name} (\n   {cols}\n)"]])
+
+    def _execute_prepared(self, stmt: A.ExecuteStmt) -> QueryResult:
+        prep = self.session.prepared.get(stmt.name)
+        if prep is None:
+            raise QueryError(
+                f"Prepared statement not found: {stmt.name}")
+        planner = LogicalPlanner(self.catalogs, self.session)
+        values = []
+        for p in stmt.params:
+            c = planner._const_expr(p)
+            lit = A.Literal(c.value)
+            values.append(lit)
+        try:
+            bound, used = A.replace_parameters(prep, values)
+        except ValueError as e:
+            raise QueryError(str(e)) from e
+        if used < len(values):
+            raise QueryError(
+                f"statement takes {used} parameters but "
+                f"{len(values)} were given")
+        return self._dispatch(bound)
+
     def _create_table(self, stmt: A.CreateTable) -> QueryResult:
         cat, schema, table = self._qualify(stmt.name)
+        self._check_access("create_table", cat, schema, table)
         conn = self.catalogs.connector(cat)
         if conn.get_table_metadata(schema, table) is not None:
             if stmt.if_not_exists:
@@ -277,6 +429,7 @@ class LocalQueryRunner:
 
     def _insert(self, stmt: A.Insert) -> QueryResult:
         cat, schema, table = self._qualify(stmt.table)
+        self._check_access("insert", cat, schema, table)
         conn = self.catalogs.connector(cat)
         meta = conn.get_table_metadata(schema, table)
         if meta is None:
@@ -301,6 +454,7 @@ class LocalQueryRunner:
         """DELETE as survivor rewrite (reference: plan/TableDeleteNode +
         connector delete; the memory connector swaps contents)."""
         cat, schema, table = self._qualify(stmt.table)
+        self._check_access("delete", cat, schema, table)
         conn = self.catalogs.connector(cat)
         meta = conn.get_table_metadata(schema, table)
         if meta is None:
@@ -331,6 +485,18 @@ class LocalQueryRunner:
             data, {c.name: c.type for c in meta.columns})
         conn.replace(schema, table, batch)
         return _msg_result("DELETE", int(total) - len(survivors.rows))
+
+    def _check_access(self, privilege: str, cat: str, schema: str,
+                      table: str) -> None:
+        ac = self.catalogs.access_control
+        if ac is None:
+            return
+        from .security import AccessDeniedError
+        try:
+            getattr(ac, f"check_can_{privilege}")(
+                self.session.user, cat, schema, table)
+        except AccessDeniedError as e:
+            raise QueryError(str(e)) from e
 
     def _qualify(self, parts: Tuple[str, ...]):
         parts = tuple(p.lower() for p in parts)

@@ -1,0 +1,161 @@
+"""Authentication + authorization (engine-side).
+
+Reference parity: server/security/ (PasswordAuthenticator flow),
+plugin/trino-password-authenticators (file-based: username:bcrypt
+lines — ours uses salted SHA-256 from hashlib since bcrypt isn't in
+the image), security/AccessControlManager.java + the SPI
+(spi/security/SystemAccessControl.java, ConnectorAccessControl), and
+the file-based access control's catalog/schema/table rules."""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class AccessDeniedError(Exception):
+    """spi/security/AccessDeniedException.java"""
+
+    def __init__(self, what: str):
+        super().__init__(f"Access Denied: {what}")
+
+
+# --------------------------------------------------------------------------
+# authentication
+# --------------------------------------------------------------------------
+
+class PasswordAuthenticator:
+    """spi/security/PasswordAuthenticator — authenticate(user, password)
+    -> bool."""
+
+    def authenticate(self, user: str, password: str) -> bool:
+        raise NotImplementedError
+
+
+class InMemoryPasswordAuthenticator(PasswordAuthenticator):
+    """Salted-hash store (the file-based authenticator's model,
+    plugin/trino-password-authenticators FileAuthenticator)."""
+
+    def __init__(self, users: Optional[Dict[str, str]] = None):
+        self._store: Dict[str, Tuple[bytes, bytes]] = {}
+        for user, pw in (users or {}).items():
+            self.set_password(user, pw)
+
+    @staticmethod
+    def _digest(salt: bytes, password: str) -> bytes:
+        return hashlib.pbkdf2_hmac("sha256", password.encode(), salt,
+                                   10_000)
+
+    def set_password(self, user: str, password: str) -> None:
+        salt = os.urandom(16)
+        self._store[user] = (salt, self._digest(salt, password))
+
+    def authenticate(self, user: str, password: str) -> bool:
+        entry = self._store.get(user)
+        if entry is None:
+            return False
+        salt, want = entry
+        return hmac.compare_digest(want, self._digest(salt, password))
+
+
+def load_password_file(text: str) -> InMemoryPasswordAuthenticator:
+    """'user:password' lines (test/dev convenience; the reference file
+    format carries bcrypt digests)."""
+    auth = InMemoryPasswordAuthenticator()
+    for line in text.splitlines():
+        line = line.strip()
+        if line and not line.startswith("#") and ":" in line:
+            user, _, pw = line.partition(":")
+            auth.set_password(user, pw)
+    return auth
+
+
+# --------------------------------------------------------------------------
+# authorization
+# --------------------------------------------------------------------------
+
+class AccessControl:
+    """SystemAccessControl SPI surface the engine consults. Default:
+    allow everything (AllowAllSystemAccessControl)."""
+
+    def check_can_select(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        pass
+
+    def check_can_create_table(self, user: str, catalog: str,
+                               schema: str, table: str) -> None:
+        pass
+
+    def check_can_drop_table(self, user: str, catalog: str,
+                             schema: str, table: str) -> None:
+        pass
+
+    def check_can_insert(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        pass
+
+    def check_can_delete(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        pass
+
+    def check_can_set_session(self, user: str, name: str) -> None:
+        pass
+
+    def check_can_kill_query(self, user: str, owner: str) -> None:
+        pass
+
+
+ALLOW_ALL = AccessControl()
+
+
+@dataclass
+class AccessRule:
+    """One rule of the file-based access control
+    (plugin resource-group-managers style): regexes over
+    (user, catalog.schema.table) -> allowed privileges."""
+    user: str = ".*"
+    table: str = ".*"            # catalog\.schema\.table regex
+    privileges: Tuple[str, ...] = ("select", "insert", "delete",
+                                   "create", "drop")
+
+    def matches(self, user: str, fqtn: str) -> bool:
+        return (re.fullmatch(self.user, user or "") is not None
+                and re.fullmatch(self.table, fqtn) is not None)
+
+
+class RuleBasedAccessControl(AccessControl):
+    """First-match-wins rule list (file-based access control
+    semantics); no matching rule denies."""
+
+    def __init__(self, rules: List[AccessRule]):
+        self.rules = list(rules)
+
+    def _check(self, privilege: str, user: str, catalog: str,
+               schema: str, table: str) -> None:
+        fqtn = f"{catalog}.{schema}.{table}"
+        for rule in self.rules:
+            if rule.matches(user, fqtn):
+                if privilege in rule.privileges:
+                    return
+                break
+        raise AccessDeniedError(
+            f"Cannot {privilege} table {fqtn} as user {user}")
+
+    def check_can_select(self, user, catalog, schema, table):
+        self._check("select", user, catalog, schema, table)
+
+    def check_can_create_table(self, user, catalog, schema, table):
+        self._check("create", user, catalog, schema, table)
+
+    def check_can_drop_table(self, user, catalog, schema, table):
+        self._check("drop", user, catalog, schema, table)
+
+    def check_can_insert(self, user, catalog, schema, table):
+        self._check("insert", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table):
+        self._check("delete", user, catalog, schema, table)

@@ -57,6 +57,8 @@ class _Query:
     error: Optional[dict] = None
     result: Optional[QueryResult] = None
     created: float = field(default_factory=time.time)
+    source: str = ""
+    group: Optional[object] = None   # assigned ResourceGroup
     _done: threading.Event = field(default_factory=threading.Event)
     _cancel: threading.Event = field(default_factory=threading.Event)
     _state_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -110,22 +112,70 @@ class _Query:
 
 class QueryTracker:
     """dispatcher/DispatchManager + execution/QueryTracker: owns every
-    query's lifecycle; one executor thread per query."""
+    query's lifecycle; one executor thread per query. Dispatch routes
+    through the resource-group manager (admission control:
+    dispatcher/DispatchManager.java:183 selectGroup) and emits
+    lifecycle events (event/QueryMonitor.java:130,206)."""
 
-    def __init__(self, make_runner):
+    def __init__(self, make_runner, events=None, resource_groups=None):
+        from .events import EventListenerManager
         self._queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
         self._counter = itertools.count(1)
         self._make_runner = make_runner
+        self.events = events or EventListenerManager()
+        self.groups = resource_groups
 
-    def submit(self, sql: str, session: Session) -> _Query:
+    def submit(self, sql: str, session: Session,
+               source: str = "") -> _Query:
+        from .events import QueryCreatedEvent, QueryCompletedEvent
+        from .resourcegroups import QueryQueueFullError
         qid = (time.strftime("%Y%m%d_%H%M%S") +
                f"_{next(self._counter):05d}")
         q = _Query(qid, uuid.uuid4().hex[:16], sql, session)
+        q.source = source
         with self._lock:
             self._queries[qid] = q
-        threading.Thread(target=q.run, args=(self._make_runner,),
-                         daemon=True).start()
+        self.events.query_created(QueryCreatedEvent(
+            qid, sql, session.user, session.catalog, session.schema))
+
+        def run_and_release():
+            try:
+                q.run(self._make_runner)
+            finally:
+                if q.group is not None and self.groups is not None:
+                    self.groups.query_finished(q.group)
+                self.events.query_completed(QueryCompletedEvent(
+                    q.query_id, q.sql, q.session.user, q.state,
+                    time.time() - q.created,
+                    rows=len(q.result.rows) if q.result else 0,
+                    error_name=(q.error or {}).get("errorName"),
+                    error_message=(q.error or {}).get("message")))
+
+        def start(group=None):
+            # the group is recorded BEFORE the thread exists so a
+            # fast-finishing query cannot race past run_and_release's
+            # slot release (q.group would still be None)
+            q.group = group
+            threading.Thread(target=run_and_release,
+                             daemon=True).start()
+
+        if self.groups is None:
+            start()
+        else:
+            try:
+                self.groups.submit(session.user, source, start,
+                                   tag=qid)
+            except QueryQueueFullError as e:
+                q.error = {"message": str(e), "errorCode": 131075,
+                           "errorName": "QUERY_QUEUE_FULL",
+                           "errorType": "INSUFFICIENT_RESOURCES"}
+                q._transition("FAILED")
+                q._done.set()
+                self.events.query_completed(QueryCompletedEvent(
+                    q.query_id, q.sql, q.session.user, "FAILED",
+                    0.0, error_name="QUERY_QUEUE_FULL",
+                    error_message=str(e)))
         return q
 
     def get(self, qid: str) -> Optional[_Query]:
@@ -136,10 +186,16 @@ class QueryTracker:
         with self._lock:
             return list(self._queries.values())
 
-    def cancel(self, qid: str):
+    def running(self) -> List[_Query]:
+        return [q for q in self.all()
+                if q.state in ("QUEUED", "RUNNING")]
+
+    def cancel(self, qid: str) -> bool:
         q = self.get(qid)
-        if q is not None:
-            q.do_cancel()
+        if q is None:
+            return False
+        q.do_cancel()
+        return True
 
 
 class Coordinator:
@@ -147,24 +203,35 @@ class Coordinator:
     port; ``base_uri`` mirrors server/Server.java's announcement."""
 
     def __init__(self, port: int = 0, distributed: bool = False,
-                 catalogs=None):
+                 catalogs=None, resource_groups=None,
+                 event_listeners=None, authenticator=None):
+        from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
         self._distributed = distributed
         self._catalogs = catalogs
+        self.authenticator = authenticator
 
         # one shared CatalogManager (memory-connector state spans
         # queries) and one shared mesh
         self._proto = LocalQueryRunner(distributed=distributed,
                                        catalogs=self._catalogs)
         self._catalogs = self._proto.catalogs
+        # system catalog backed by THIS coordinator
+        from ..connectors.system import SystemConnector
+        self._catalogs.register("system", SystemConnector(self))
 
         def make_runner(session: Session) -> LocalQueryRunner:
             return LocalQueryRunner(session=session,
                                     catalogs=self._catalogs,
                                     mesh=self._proto.mesh)
 
-        self.tracker = QueryTracker(make_runner)
+        events = EventListenerManager()
+        for listener in (event_listeners or []):
+            events.add_listener(listener)
+        self.resource_groups = resource_groups
+        self.tracker = QueryTracker(make_runner, events,
+                                    resource_groups)
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
         self.port = self._httpd.server_address[1]
@@ -238,10 +305,76 @@ class Coordinator:
 
     def query_infos(self) -> list:
         return [{"queryId": q.query_id, "state": q.state,
-                 "query": q.sql,
+                 "query": q.sql, "user": q.session.user,
+                 "source": q.source,
+                 "created": time.strftime(
+                     "%Y-%m-%d %H:%M:%S", time.localtime(q.created)),
                  "elapsedTimeMillis":
                      int((time.time() - q.created) * 1000)}
                 for q in self.tracker.all()]
+
+    # ---- SystemProvider SPI (connectors/system.py) --------------------
+    def node_infos(self) -> list:
+        nodes = [{"nodeId": self.node_id, "uri": self.base_uri,
+                  "nodeVersion": "trino-tpu-0.1", "coordinator": True,
+                  "state": "active"}]
+        detector = getattr(self, "failure_detector", None)
+        workers = getattr(self, "workers", None) or []
+        for w in workers:
+            state = "active"
+            if detector is not None and not detector.is_alive(w):
+                state = "failed"
+            nodes.append({"nodeId": w, "uri": w,
+                          "nodeVersion": "trino-tpu-0.1",
+                          "coordinator": False, "state": state})
+        return nodes
+
+    def resource_group_infos(self) -> list:
+        if self.resource_groups is None:
+            return []
+        return self.resource_groups.info()
+
+    def kill_query(self, query_id: str) -> bool:
+        return self.tracker.cancel(query_id)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Graceful shutdown: wait for active queries to finish
+        (server/GracefulShutdownHandler.java:43,73), then stop."""
+        deadline = time.time() + timeout
+        for q in self.tracker.running():
+            q.wait_done(max(0.0, deadline - time.time()))
+        self.stop()
+        return not self.tracker.running()
+
+
+_UI_PAGE = """<!doctype html>
+<html><head><title>trino-tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}
+h1{font-size:1.3em} table{border-collapse:collapse;width:100%}
+td,th{border:1px solid #ddd;padding:6px 10px;text-align:left;
+font-size:0.9em} th{background:#f0f0f0}
+.FINISHED{color:#188038}.FAILED{color:#d93025}.RUNNING{color:#1a73e8}
+.QUEUED{color:#e37400}.CANCELED{color:#5f6368}
+</style></head><body>
+<h1>trino-tpu cluster</h1><div id=info></div>
+<h2>Queries</h2><table id=q><tr><th>Query ID</th><th>State</th>
+<th>User</th><th>Elapsed</th><th>SQL</th></tr></table>
+<script>
+async function refresh(){
+ const info=await (await fetch('/v1/info')).json();
+ document.getElementById('info').textContent=
+   'node '+info.nodeId+' — uptime '+info.uptime;
+ const qs=await (await fetch('/v1/query')).json();
+ const t=document.getElementById('q');
+ while(t.rows.length>1)t.deleteRow(1);
+ for(const q of qs.reverse()){
+  const r=t.insertRow(); r.insertCell().textContent=q.queryId;
+  const s=r.insertCell(); s.textContent=q.state; s.className=q.state;
+  r.insertCell().textContent=q.user||'';
+  r.insertCell().textContent=(q.elapsedTimeMillis/1000).toFixed(1)+'s';
+  r.insertCell().textContent=q.query.slice(0,120);}}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
 
 
 def _make_handler(co: Coordinator):
@@ -259,7 +392,43 @@ def _make_handler(co: Coordinator):
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_html(self, body: str):
+            raw = body.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(raw)))
+            self.end_headers()
+            self.wfile.write(raw)
+
+        def _authenticate(self) -> bool:
+            """HTTP Basic auth against the configured password
+            authenticator (server/security/PasswordAuthenticator
+            analog); no authenticator = open access."""
+            if co.authenticator is None:
+                return True
+            import base64
+            header = self.headers.get("Authorization", "")
+            if header.startswith("Basic "):
+                try:
+                    raw = base64.b64decode(header[6:]).decode()
+                    user, _, pw = raw.partition(":")
+                    if co.authenticator.authenticate(user, pw):
+                        return True
+                except Exception:
+                    pass
+            body = json.dumps({"error": "Unauthorized"}).encode()
+            self.send_response(401)
+            self.send_header("WWW-Authenticate",
+                             'Basic realm="trino-tpu"')
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return False
+
         def do_POST(self):
+            if not self._authenticate():
+                return
             path = urlparse(self.path).path
             if path == "/v1/statement":
                 n = int(self.headers.get("Content-Length", 0))
@@ -276,15 +445,32 @@ def _make_handler(co: Coordinator):
                             session.set(k.strip(), v.strip())
                         except KeyError:
                             pass
-                q = co.tracker.submit(sql, session)
+                q = co.tracker.submit(
+                    sql, session,
+                    source=self.headers.get("X-Trino-Source", ""))
                 q.wait_done(0.05)   # fast queries answer immediately
                 self._send(200, co.query_results(q, 0))
                 return
             self._send(404, {"error": "not found"})
 
         def do_GET(self):
+            if not self._authenticate():
+                return
             path = urlparse(self.path).path
             parts = [p for p in path.split("/") if p]
+            if path == "/ui" or path == "/ui/":
+                self._send_html(_UI_PAGE)
+                return
+            if path == "/v1/cluster":
+                qs = co.tracker.all()
+                self._send(200, {
+                    "runningQueries": sum(
+                        1 for q in qs if q.state == "RUNNING"),
+                    "queuedQueries": sum(
+                        1 for q in qs if q.state == "QUEUED"),
+                    "totalQueries": len(qs),
+                    "activeWorkers": len(co.node_infos())})
+                return
             if path == "/v1/info":
                 self._send(200, co.info())
                 return
@@ -313,6 +499,8 @@ def _make_handler(co: Coordinator):
             self._send(404, {"error": "not found"})
 
         def do_DELETE(self):
+            if not self._authenticate():
+                return
             parts = [p for p in urlparse(self.path).path.split("/") if p]
             if len(parts) >= 4 and parts[:2] == ["v1", "statement"]:
                 co.tracker.cancel(parts[3])

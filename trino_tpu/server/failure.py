@@ -1,0 +1,128 @@
+"""Heartbeat failure detector.
+
+Reference parity: failuredetector/HeartbeatFailureDetector.java:78,93,
+221,318-351 — the coordinator pings every known service on a fixed
+cadence and tracks an EXPONENTIALLY DECAYED failure ratio per node;
+nodes above ``failure_ratio_threshold`` are reported failed and the
+scheduler excludes them (NodeScheduler consulting the detector). Ours
+pings the worker's /v1/info (server/task_worker.py exposes it) or any
+HTTP URI; a pluggable ``probe`` hook lets tests inject failures."""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+
+@dataclass
+class _Stats:
+    """Per-service decayed failure ratio
+    (HeartbeatFailureDetector.Stats)."""
+    decay_seconds: float = 30.0
+    weight: float = 0.0           # decayed total probes
+    failed: float = 0.0           # decayed failures
+    last_update: float = field(default_factory=time.time)
+    last_failure: Optional[str] = None
+
+    def _decay(self, now: float) -> None:
+        dt = max(0.0, now - self.last_update)
+        k = math.exp(-dt / self.decay_seconds)
+        self.weight *= k
+        self.failed *= k
+        self.last_update = now
+
+    def record(self, success: bool, error: Optional[str] = None):
+        now = time.time()
+        self._decay(now)
+        self.weight += 1.0
+        if not success:
+            self.failed += 1.0
+            self.last_failure = error
+
+    @property
+    def failure_ratio(self) -> float:
+        if self.weight <= 0:
+            return 0.0
+        return self.failed / self.weight
+
+
+class HeartbeatFailureDetector:
+    """Background pinger + failed-node query surface."""
+
+    def __init__(self, interval_s: float = 0.5,
+                 failure_ratio_threshold: float = 0.1,
+                 warmup_probes: int = 2,
+                 probe: Optional[Callable[[str], bool]] = None,
+                 timeout_s: float = 2.0):
+        self.interval_s = interval_s
+        self.threshold = failure_ratio_threshold
+        self.warmup = warmup_probes
+        self.timeout_s = timeout_s
+        self._probe = probe or self._http_probe
+        self._stats: Dict[str, _Stats] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _http_probe(self, uri: str) -> bool:
+        try:
+            with urllib.request.urlopen(uri.rstrip("/") + "/v1/info",
+                                        timeout=self.timeout_s) as r:
+                json.loads(r.read())
+            return True
+        except Exception:
+            return False
+
+    # --- membership ------------------------------------------------------
+    def add_service(self, uri: str) -> None:
+        with self._lock:
+            self._stats.setdefault(uri, _Stats())
+
+    def remove_service(self, uri: str) -> None:
+        with self._lock:
+            self._stats.pop(uri, None)
+
+    def services(self) -> List[str]:
+        with self._lock:
+            return list(self._stats)
+
+    # --- probing ---------------------------------------------------------
+    def probe_once(self) -> None:
+        for uri in self.services():
+            ok = False
+            err = None
+            try:
+                ok = self._probe(uri)
+            except Exception as e:
+                err = str(e)
+            with self._lock:
+                st = self._stats.get(uri)
+                if st is not None:
+                    st.record(ok, err)
+
+    def start(self) -> "HeartbeatFailureDetector":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                self.probe_once()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # --- queries ---------------------------------------------------------
+    def is_alive(self, uri: str) -> bool:
+        with self._lock:
+            st = self._stats.get(uri)
+            if st is None or st.weight < self.warmup:
+                return True       # unknown/warming-up nodes pass
+            return st.failure_ratio <= self.threshold
+
+    def failed(self) -> List[str]:
+        return [u for u in self.services() if not self.is_alive(u)]

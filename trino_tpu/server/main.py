@@ -1,0 +1,135 @@
+"""Server entry point: ``python -m trino_tpu.server.main`` (or the
+``trino-tpu-server`` console script).
+
+Reference parity: core/trino-server-main (TrinoServer.java) +
+server/Server.java bootstrap + the airlift config loading model:
+``etc/config.properties`` (http-server.http.port, coordinator=...),
+``etc/catalog/*.properties`` (connector.name=tpch|memory|...) —
+metadata/CatalogManager + connector/ConnectorManager analog."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+from typing import Dict, Optional
+
+
+def load_properties(path: str) -> Dict[str, str]:
+    """key=value lines, '#' comments (airlift config format)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" in line:
+                k, _, v = line.partition("=")
+                out[k.strip()] = v.strip()
+    return out
+
+
+def build_catalogs(etc_dir: Optional[str]):
+    """etc/catalog/*.properties -> CatalogManager
+    (connector.name selects the plugin, like the reference's catalog
+    property files)."""
+    from ..catalog import CatalogManager
+    from ..connectors.memory import (BlackholeConnector,
+                                     MemoryConnector)
+    from ..connectors.system import SystemConnector
+    from ..connectors.tpcds import TpcdsConnector
+    from ..connectors.tpch import TpchConnector
+    cat_dir = os.path.join(etc_dir, "catalog") if etc_dir else None
+    mgr = CatalogManager()
+    made = False
+    if cat_dir and os.path.isdir(cat_dir):
+        for fn in sorted(os.listdir(cat_dir)):
+            if not fn.endswith(".properties"):
+                continue
+            name = fn[:-len(".properties")]
+            props = load_properties(os.path.join(cat_dir, fn))
+            kind = props.get("connector.name", name)
+            if kind == "tpch":
+                mgr.register(name, TpchConnector())
+            elif kind == "tpcds":
+                mgr.register(name, TpcdsConnector())
+            elif kind == "memory":
+                mgr.register(name, MemoryConnector())
+            elif kind == "blackhole":
+                mgr.register(name, BlackholeConnector())
+            elif kind == "localfile":
+                from ..connectors.localfile import LocalFileConnector
+                mgr.register(name, LocalFileConnector(
+                    props.get("localfile.root", ".")))
+            else:
+                print(f"warning: unknown connector.name={kind} "
+                      f"for catalog {name}", file=sys.stderr)
+            made = True
+    if not made:
+        mgr.register("tpch", TpchConnector())
+        mgr.register("tpcds", TpcdsConnector())
+        mgr.register("memory", MemoryConnector())
+        mgr.register("blackhole", BlackholeConnector())
+    return mgr
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="trino-tpu-server")
+    ap.add_argument("--etc-dir", default=None,
+                    help="config directory (config.properties + "
+                         "catalog/*.properties)")
+    ap.add_argument("--port", type=int, default=None)
+    ap.add_argument("--distributed", action="store_true",
+                    help="execute over the device mesh")
+    args = ap.parse_args(argv)
+
+    props: Dict[str, str] = {}
+    if args.etc_dir:
+        cfg = os.path.join(args.etc_dir, "config.properties")
+        if os.path.exists(cfg):
+            props = load_properties(cfg)
+    port = args.port if args.port is not None else \
+        int(props.get("http-server.http.port", "8080"))
+
+    from .coordinator import Coordinator
+    resource_groups = None
+    rg_path = props.get("resource-groups.config-file")
+    if rg_path:
+        import json as _json
+        from .resourcegroups import ResourceGroupManager
+        with open(rg_path) as f:
+            resource_groups = ResourceGroupManager.from_config(
+                _json.load(f))
+    authenticator = None
+    pw_path = props.get("password-authenticator.file")
+    if pw_path:
+        from ..security import load_password_file
+        with open(pw_path) as f:
+            authenticator = load_password_file(f.read())
+
+    co = Coordinator(port=port,
+                     distributed=args.distributed,
+                     catalogs=build_catalogs(args.etc_dir),
+                     resource_groups=resource_groups,
+                     authenticator=authenticator).start()
+    print(f"trino-tpu coordinator listening on {co.base_uri}"
+          f" (web UI: {co.base_uri}/ui)")
+
+    stop = {"flag": False}
+
+    def on_signal(sig, frame):
+        print("draining...", file=sys.stderr)
+        co.drain(timeout=30.0)
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    import time
+    while not stop["flag"]:
+        time.sleep(0.2)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

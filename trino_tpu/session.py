@@ -31,6 +31,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     "spill_enabled": (bool, CONFIG.spill_enabled),   # :91
     "enable_dynamic_filtering": (bool, True),        # :123
     "query_max_memory_per_node": (int, CONFIG.max_query_memory_per_node),
+    # connector pushdown (PushPredicateIntoTableScan /
+    # PushLimitIntoTableScan); consulted by planner/optimizer.py
+    "pushdown_into_scan": (bool, True),
 }
 
 
@@ -43,6 +46,9 @@ class Session:
     # cooperative cancellation: the executor checks this between plan
     # nodes (execution/QueryStateMachine's transitionToCanceled analog)
     cancel: Optional[object] = None
+    # PREPARE name FROM stmt registry (reference: Session.java
+    # preparedStatements + execution/PrepareTask.java)
+    prepared: Dict[str, object] = field(default_factory=dict)
 
     def get(self, name: str):
         if name in self.properties:

@@ -432,6 +432,121 @@ class UseStatement(Statement):
     schema: str = ""
 
 
+@dataclass(frozen=True)
+class CreateView(Statement):
+    name: Tuple[str, ...] = ()
+    query: Query = None  # type: ignore
+    replace: bool = False
+
+
+@dataclass(frozen=True)
+class DropView(Statement):
+    name: Tuple[str, ...] = ()
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class ShowCreate(Statement):
+    kind: str = "table"       # table | view
+    name: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class Prepare(Statement):
+    name: str = ""
+    statement: Statement = None  # type: ignore
+
+
+@dataclass(frozen=True)
+class ExecuteStmt(Statement):
+    name: str = ""
+    params: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class Deallocate(Statement):
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class Describe(Statement):
+    table: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class DescribeInput(Statement):
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class DescribeOutput(Statement):
+    name: str = ""
+
+
+@dataclass(frozen=True)
+class CallStatement(Statement):
+    name: Tuple[str, ...] = ()
+    args: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class StartTransaction(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Commit(Statement):
+    pass
+
+
+@dataclass(frozen=True)
+class Rollback(Statement):
+    pass
+
+
+def replace_parameters(node, values):
+    """Substitute `?` placeholders (Literal(type_name='parameter')) with
+    the given Literal values, in source order (reference:
+    sql/planner/ParameterRewriter.java). Raises ValueError on arity
+    mismatch."""
+    import dataclasses as _dc
+    state = [0]
+
+    def conv(v):
+        if isinstance(v, Node):
+            return go(v)
+        if isinstance(v, tuple):
+            return tuple(conv(x) for x in v)
+        return v
+
+    def go(n):
+        if isinstance(n, Literal) and n.type_name == "parameter":
+            i = state[0]
+            state[0] += 1
+            if i >= len(values):
+                raise ValueError(
+                    f"query takes at least {state[0]} parameters but "
+                    f"only {len(values)} were given")
+            return values[i]
+        if hasattr(n, "__dataclass_fields__"):
+            changes = {}
+            for f in n.__dataclass_fields__:
+                v = getattr(n, f)
+                nv = conv(v)
+                if nv is not v:
+                    changes[f] = nv
+            return _dc.replace(n, **changes) if changes else n
+        return n
+
+    out = go(node)
+    return out, state[0]
+
+
+def count_parameters(node) -> int:
+    return sum(1 for e in walk_expressions(node)
+               if isinstance(e, Literal) and e.type_name == "parameter")
+
+
 def walk_expressions(node):
     """Yield every Expression reachable from an AST node (pre-order)."""
     stack = [node]

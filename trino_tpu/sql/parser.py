@@ -170,15 +170,83 @@ class _Parser:
                 return A.UseStatement(parts[0], parts[1])
             return A.UseStatement(None, parts[0])
         if self.at_kw("create"):
+            if self.at_kw("view", ahead=1) or (
+                    self.at_kw("or", ahead=1)
+                    and self.at_kw("replace", ahead=2)
+                    and self.at_kw("view", ahead=3)):
+                self.next()
+                replace = False
+                if self.accept_kw("or"):
+                    self.expect_kw("replace")
+                    replace = True
+                self.expect_kw("view")
+                name = self.qualified_name()
+                self.expect_kw("as")
+                return A.CreateView(name, self.query(), replace)
             return self._create_table()
         if self.at_kw("drop"):
             self.next()
-            self.expect_kw("table")
+            kind = "view" if self.accept_kw("view") else "table"
+            if kind == "table":
+                self.expect_kw("table")
             if_exists = False
             if self.accept_kw("if"):
                 self.expect_kw("exists")
                 if_exists = True
+            if kind == "view":
+                return A.DropView(self.qualified_name(), if_exists)
             return A.DropTable(self.qualified_name(), if_exists)
+        if self.at_kw("describe", "desc"):
+            self.next()
+            if self.accept_kw("input"):
+                return A.DescribeInput(self.identifier())
+            if self.accept_kw("output"):
+                return A.DescribeOutput(self.identifier())
+            return A.Describe(self.qualified_name())
+        if self.at_kw("prepare"):
+            self.next()
+            name = self.identifier()
+            self.expect_kw("from")
+            return A.Prepare(name, self._statement())
+        if self.at_kw("execute"):
+            self.next()
+            name = self.identifier()
+            params: List[A.Expression] = []
+            if self.accept_kw("using"):
+                params.append(self.expression())
+                while self.accept_op(","):
+                    params.append(self.expression())
+            return A.ExecuteStmt(name, tuple(params))
+        if self.at_kw("deallocate"):
+            self.next()
+            self.accept_kw("prepare")
+            return A.Deallocate(self.identifier())
+        if self.at_kw("call"):
+            self.next()
+            name = self.qualified_name()
+            args: List[A.Expression] = []
+            self.expect_op("(")
+            if not self.at_op(")"):
+                args.append(self.expression())
+                while self.accept_op(","):
+                    args.append(self.expression())
+            self.expect_op(")")
+            return A.CallStatement(name, tuple(args))
+        if self.at_kw("start"):
+            self.next()
+            self.expect_kw("transaction")
+            # isolation/read-only modifiers accepted and ignored
+            while self.peek().kind != "eof":
+                self.next()
+            return A.StartTransaction()
+        if self.at_kw("commit"):
+            self.next()
+            self.accept_kw("work")
+            return A.Commit()
+        if self.at_kw("rollback"):
+            self.next()
+            self.accept_kw("work")
+            return A.Rollback()
         if self.at_kw("insert"):
             self.next()
             self.expect_kw("into")
@@ -228,6 +296,11 @@ class _Parser:
             return A.ShowSession()
         if self.accept_kw("functions"):
             return A.ShowFunctions()
+        if self.accept_kw("create"):
+            kind = "view" if self.accept_kw("view") else "table"
+            if kind == "table":
+                self.expect_kw("table")
+            return A.ShowCreate(kind, self.qualified_name())
         t = self.peek()
         raise ParseError(f"unsupported SHOW {t.value!r}", t.line, t.column)
 
@@ -807,6 +880,14 @@ class _Parser:
                 while self.accept_op(","):
                     items.append(self.expression())
                 self.expect_op(")")
+                # (x, y) -> expr multi-parameter lambda
+                if self.at_op("->", "=>") and all(
+                        isinstance(i, A.Identifier) and len(i.parts) == 1
+                        for i in items):
+                    self.next()
+                    return A.LambdaExpression(
+                        tuple(i.parts[0] for i in items),
+                        self.expression())
                 return A.RowConstructor(tuple(items))
             self.expect_op(")")
             # (x) -> y lambda

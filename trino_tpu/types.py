@@ -28,7 +28,8 @@ import numpy as np
 __all__ = [
     "Type", "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT", "REAL",
     "DOUBLE", "VARCHAR", "VARBINARY", "DATE", "UNKNOWN", "DecimalType",
-    "VarcharType", "CharType", "TimestampType", "ArrayType", "RowType",
+    "VarcharType", "CharType", "TimestampType", "TimeType", "ArrayType",
+    "MapType", "RowType",
     "IntervalDayTime", "IntervalYearMonth", "parse_type", "common_super_type",
     "is_numeric", "is_integral", "is_exact_numeric", "is_string",
 ]
@@ -194,6 +195,20 @@ class ArrayType(Type):
 
 
 @dataclass(frozen=True)
+class MapType(Type):
+    """MAP(k, v): physically offsets+lengths lanes over two flat element
+    columns (keys, values) — spi/type/MapType.java redesigned as
+    struct-of-arrays like ArrayType (see columnar.Column docstring)."""
+    key: Type = None    # type: ignore
+    value: Type = None  # type: ignore
+
+    def __init__(self, key: Type, value: Type):
+        object.__setattr__(self, "name", f"map({key.name}, {value.name})")
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "value", value)
+
+
+@dataclass(frozen=True)
 class RowType(Type):
     fields: Tuple[Tuple[Optional[str], Type], ...] = ()
 
@@ -280,7 +295,48 @@ def common_super_type(a: Type, b: Type) -> Optional[Type]:
         return b
     if b == DATE and isinstance(a, TimestampType):
         return a
+    if isinstance(a, ArrayType) and isinstance(b, ArrayType):
+        e = common_super_type(a.element, b.element)
+        return None if e is None else ArrayType(e)
+    if isinstance(a, MapType) and isinstance(b, MapType):
+        k = common_super_type(a.key, b.key)
+        v = common_super_type(a.value, b.value)
+        return None if k is None or v is None else MapType(k, v)
+    if isinstance(a, RowType) and isinstance(b, RowType):
+        if len(a.fields) != len(b.fields):
+            return None
+        fields = []
+        for (na, ta), (nb, tb) in zip(a.fields, b.fields):
+            t = common_super_type(ta, tb)
+            if t is None:
+                return None
+            fields.append((na if na == nb else None, t))
+        return RowType(fields)
     return None
+
+
+def _split_top_level(s: str):
+    """Split on commas not nested inside parentheses."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return parts
+
+
+def _looks_like_type(tok: str) -> bool:
+    tok = tok.split("(")[0]
+    return (tok in _SIMPLE
+            or tok in ("decimal", "char", "timestamp", "time", "array",
+                       "map", "row"))
 
 
 _TYPE_RE = re.compile(r"^\s*([a-z_ ]+?)\s*(?:\(\s*([0-9]+)\s*(?:,\s*([0-9]+)\s*)?\))?\s*$")
@@ -301,6 +357,22 @@ def parse_type(s: str) -> Type:
     low = s.strip().lower()
     if low.startswith("array(") and low.endswith(")"):
         return ArrayType(parse_type(low[len("array("):-1]))
+    if low.startswith("map(") and low.endswith(")"):
+        parts = _split_top_level(low[len("map("):-1])
+        if len(parts) != 2:
+            raise ValueError(f"cannot parse map type: {s!r}")
+        return MapType(parse_type(parts[0]), parse_type(parts[1]))
+    if low.startswith("row(") and low.endswith(")"):
+        fields = []
+        for part in _split_top_level(low[len("row("):-1]):
+            part = part.strip()
+            # "name type" or bare "type"
+            toks = part.split(None, 1)
+            if len(toks) == 2 and not _looks_like_type(toks[0]):
+                fields.append((toks[0], parse_type(toks[1])))
+            else:
+                fields.append((None, parse_type(part)))
+        return RowType(fields)
     m = _TYPE_RE.match(s.lower())
     if not m:
         raise ValueError(f"cannot parse type: {s!r}")
