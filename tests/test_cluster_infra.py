@@ -226,6 +226,29 @@ def test_http_basic_auth():
         co.stop()
 
 
+def test_authenticated_principal_binds_session_user():
+    import base64
+    auth = InMemoryPasswordAuthenticator({"alice": "pw"})
+    co = Coordinator(authenticator=auth).start()
+    try:
+        cred = base64.b64encode(b"alice:pw").decode()
+        req = urllib.request.Request(
+            co.base_uri + "/v1/statement", data=b"SELECT 1",
+            headers={"Authorization": f"Basic {cred}",
+                     "X-Trino-User": "admin"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=10)
+        assert e.value.code == 403      # no impersonation via header
+        req = urllib.request.Request(
+            co.base_uri + "/v1/statement", data=b"SELECT 1",
+            headers={"Authorization": f"Basic {cred}",
+                     "X-Trino-User": "alice"}, method="POST")
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+    finally:
+        co.stop()
+
+
 def test_access_control_rules():
     ac = RuleBasedAccessControl([
         AccessRule(user="alice", table=r"tpch\..*",

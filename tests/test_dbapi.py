@@ -1,0 +1,90 @@
+"""DB-API 2.0 driver (the trino-jdbc analog).
+
+Reference parity: client/trino-jdbc — statement execution with
+parameter binding over the REST client protocol.
+"""
+
+import pytest
+
+from trino_tpu.dbapi import ProgrammingError, connect
+from trino_tpu.server.coordinator import Coordinator
+
+
+@pytest.fixture(scope="module")
+def coordinator():
+    co = Coordinator().start()
+    yield co
+    co.stop()
+
+
+def test_basic_query(coordinator):
+    with connect(coordinator.base_uri) as conn:
+        cur = conn.cursor()
+        cur.execute("SELECT r_regionkey, r_name FROM "
+                    "tpch.tiny.region ORDER BY r_regionkey")
+        assert cur.description[0][0] == "r_regionkey"
+        assert cur.rowcount == 5
+        first = cur.fetchone()
+        assert first == [0, "AFRICA"]
+        rest = cur.fetchall()
+        assert len(rest) == 4
+
+
+def test_qmark_parameters(coordinator):
+    conn = connect(coordinator.base_uri)
+    cur = conn.cursor()
+    cur.execute("SELECT n_name FROM tpch.tiny.nation WHERE "
+                "n_nationkey = ?", (3,))
+    assert cur.fetchall() == [["CANADA"]]
+    cur.execute("SELECT count(*) FROM tpch.tiny.nation WHERE "
+                "n_name < ? AND n_regionkey = ?", ("CANADA", 1))
+    assert cur.fetchone() == [2]
+
+
+def test_fetchmany_iteration(coordinator):
+    cur = connect(coordinator.base_uri).cursor()
+    cur.execute("SELECT n_nationkey FROM tpch.tiny.nation "
+                "ORDER BY n_nationkey")
+    assert cur.fetchmany(3) == [[0], [1], [2]]
+    assert len(list(cur)) == 22
+
+
+def test_ddl_and_rowcount(coordinator):
+    conn = connect(coordinator.base_uri, catalog="memory",
+                   schema="default")
+    cur = conn.cursor()
+    cur.execute("CREATE TABLE memory.default.dbapi_t (x bigint)")
+    cur.execute("INSERT INTO memory.default.dbapi_t VALUES (1), (2)")
+    assert cur.rowcount == 2
+    cur.execute("SELECT count(*) FROM memory.default.dbapi_t")
+    assert cur.fetchone() == [2]
+    cur.execute("DROP TABLE memory.default.dbapi_t")
+
+
+def test_parameters_through_proxy(coordinator):
+    from trino_tpu.server.proxy import Proxy
+    px = Proxy(coordinator.base_uri).start()
+    try:
+        cur = connect(px.base_uri).cursor()
+        cur.execute("SELECT n_name FROM tpch.tiny.nation WHERE "
+                    "n_nationkey = ?", (3,))
+        assert cur.fetchall() == [["CANADA"]]
+    finally:
+        px.stop()
+
+
+def test_render_param_edge_values():
+    import decimal
+    from trino_tpu.dbapi import _render_param
+    assert _render_param(float("inf")) == "infinity()"
+    assert _render_param(float("-inf")) == "-infinity()"
+    assert _render_param(float("nan")) == "nan()"
+    assert _render_param(decimal.Decimal("1.25")) == "1.25"
+    assert _render_param(None) == "NULL"
+    assert _render_param("o'brien") == "'o''brien'"
+
+
+def test_error_raises(coordinator):
+    cur = connect(coordinator.base_uri).cursor()
+    with pytest.raises(ProgrammingError):
+        cur.execute("SELECT * FROM tpch.tiny.not_a_table")

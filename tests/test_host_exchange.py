@@ -115,15 +115,19 @@ def test_two_process_partial_final_aggregation():
     ctx = mp.get_context("spawn")
     workers = []
     try:
-        for _ in range(2):
-            parent, child = ctx.Pipe()
-            # pin children to CPU: they must not contend for the
-            # exclusive TPU chip on an attached host
-            p = ctx.Process(target=worker_main, args=(child, "cpu"),
-                            daemon=True)
-            p.start()
-            port = parent.recv()
-            workers.append((p, f"http://127.0.0.1:{port}"))
+        from trino_tpu.server.task_worker import spawn_worker_env
+        with spawn_worker_env():
+            # scrubbed env: spawn children must not run the
+            # TPU-forcing sitecustomize (hangs when the tunnel is down)
+            for _ in range(2):
+                parent, child = ctx.Pipe()
+                p = ctx.Process(target=worker_main,
+                                args=(child, "cpu"), daemon=True)
+                p.start()
+                if not parent.poll(120):
+                    raise RuntimeError("worker child did not start")
+                port = parent.recv()
+                workers.append((p, f"http://127.0.0.1:{port}"))
 
         partial_sql = ("SELECT o_orderpriority AS pri, "
                        "count(*) AS c, sum(o_totalprice) AS s "

@@ -188,6 +188,19 @@ def test_histogram(runner):
                    [4, {0: 5}]]
 
 
+def test_approx_most_frequent(runner):
+    got = q(runner, "SELECT approx_most_frequent(2, n_regionkey) "
+                    "FROM tpch.tiny.nation")
+    assert len(got[0][0]) == 2
+    assert all(v == 5 for v in got[0][0].values())
+    got = q(runner, "SELECT n_regionkey, "
+                    "approx_most_frequent(1, n_nationkey % 2) "
+                    "FROM tpch.tiny.nation GROUP BY n_regionkey "
+                    "ORDER BY n_regionkey")
+    assert got == [[0, {0: 3}], [1, {1: 3}], [2, {0: 3}],
+                   [3, {1: 3}], [4, {0: 3}]]
+
+
 def test_lambda_in_where(runner):
     got = q(runner, "SELECT n_name FROM tpch.tiny.nation "
                     "WHERE any_match(ARRAY[n_nationkey], x -> x = 3)")

@@ -44,6 +44,17 @@ class TableMetadata:
 
 
 @dataclass(frozen=True)
+class ColumnStatistics:
+    """spi/statistics/ColumnStatistics.java: distinct-value count,
+    value range (numeric/date columns; None for strings), null
+    fraction."""
+    ndv: float
+    min_value: Optional[float] = None
+    max_value: Optional[float] = None
+    null_fraction: float = 0.0
+
+
+@dataclass(frozen=True)
 class ViewDefinition:
     """Engine view object (reference: metadata/ViewDefinition.java):
     the parsed query plus the original SQL text for SHOW CREATE VIEW."""
@@ -82,6 +93,10 @@ class Connector:
 
     name: str = "connector"
 
+    # Splits are deterministic + immutable (pure generators): the
+    # engine may cache read results device-resident across queries.
+    scan_cache_ok: bool = False
+
     # --- metadata --------------------------------------------------------
     def list_schemas(self) -> List[str]:
         raise NotImplementedError
@@ -107,6 +122,12 @@ class Connector:
 
     # --- statistics (spi/statistics/TableStatistics.java) ----------------
     def table_row_count(self, handle: TableHandle) -> Optional[float]:
+        return None
+
+    def column_statistics(self, handle: TableHandle,
+                          column: str) -> Optional["ColumnStatistics"]:
+        """Per-column stats for the CBO (spi/statistics/
+        ColumnStatistics.java); None = unknown."""
         return None
 
     # --- pushdown hooks (ConnectorMetadata.applyFilter/applyLimit) -------

@@ -43,9 +43,15 @@ class StatementClient:
         self.schema = schema
         self.session_properties = dict(session_properties or {})
         self.timeout = timeout
+        # client-held prepared statements, replayed on every request
+        # via X-Trino-Prepared-Statement (ProtocolHeaders.java — the
+        # coordinator's sessions are per-request, so prepared state
+        # lives client-side exactly like the reference protocol)
+        self.prepared: Dict[str, str] = {}
 
     def _request(self, method: str, uri: str, body: Optional[bytes]
                  = None) -> dict:
+        from urllib.parse import quote
         req = urllib.request.Request(uri, data=body, method=method)
         req.add_header("X-Trino-User", self.user)
         req.add_header("X-Trino-Catalog", self.catalog)
@@ -53,6 +59,10 @@ class StatementClient:
         if self.session_properties:
             req.add_header("X-Trino-Session", ",".join(
                 f"{k}={v}" for k, v in self.session_properties.items()))
+        if self.prepared:
+            req.add_header("X-Trino-Prepared-Statement", ",".join(
+                f"{name}={quote(sql)}"
+                for name, sql in self.prepared.items()))
         with urllib.request.urlopen(req, timeout=self.timeout) as resp:
             payload = resp.read()
         return json.loads(payload) if payload else {}
@@ -76,5 +86,21 @@ class StatementClient:
                                            out.update_count)
             nxt = payload.get("nextUri")
             if not nxt:
+                self._track_prepared(sql, out)
                 return out
             payload = self._request("GET", nxt)
+
+    def _track_prepared(self, sql: str, out: ClientResult) -> None:
+        """Keep the client-side prepared-statement registry in sync
+        with successful PREPARE/DEALLOCATE statements."""
+        import re
+        if out.update_type == "PREPARE":
+            m = re.match(r"\s*prepare\s+(\w+)\s+from\s+(.*)\Z", sql,
+                         re.IGNORECASE | re.DOTALL)
+            if m:
+                self.prepared[m.group(1)] = m.group(2).strip()
+        elif out.update_type == "DEALLOCATE":
+            m = re.match(r"\s*deallocate\s+(?:prepare\s+)?(\w+)", sql,
+                         re.IGNORECASE)
+            if m:
+                self.prepared.pop(m.group(1), None)

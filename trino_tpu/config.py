@@ -44,6 +44,11 @@ class EngineConfig:
     )
     # Enable host spill when device memory is exhausted.
     spill_enabled: bool = os.environ.get("TRINO_TPU_SPILL", "1") == "1"
+    # HBM-resident scan cache budget for immutable generator connectors
+    # (tpch/tpcds): table columns live in device memory across queries
+    # — the "storage layer in HBM" design of README.md. 0 disables.
+    scan_cache_bytes: int = _env_int("TRINO_TPU_SCAN_CACHE",
+                                     4 << 30)
 
 
 CONFIG = EngineConfig()

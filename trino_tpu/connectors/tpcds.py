@@ -204,6 +204,7 @@ def _price(seed: int, idx: np.ndarray, lo: float, hi: float) -> np.ndarray:
 
 class TpcdsConnector(Connector):
     name = "tpcds"
+    scan_cache_ok = True      # pure generator: splits are immutable
 
     def __init__(self, rows_per_split: int = 1 << 17):
         self.rows_per_split = rows_per_split

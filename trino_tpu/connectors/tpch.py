@@ -333,6 +333,7 @@ class _LineFields:
 
 class TpchConnector(Connector):
     name = "tpch"
+    scan_cache_ok = True      # pure generator: splits are immutable
 
     def __init__(self, rows_per_split: int = 1 << 17):
         self.rows_per_split = rows_per_split
@@ -354,6 +355,70 @@ class TpchConnector(Connector):
         if handle.table == "lineitem":
             return table_rows("orders", sf) * 4.0
         return float(table_rows(handle.table, sf))
+
+    def column_statistics(self, handle: TableHandle, column: str):
+        """Analytic per-column stats from the TPC-H spec's value
+        domains, scaled by SF (reference:
+        plugin/trino-tpch/.../statistics/ ships precomputed stats
+        files; ours derive from the same spec formulas)."""
+        from ..catalog import ColumnStatistics as CS
+        sf = SCHEMAS[handle.schema]
+
+        def rows(t):
+            return float(table_rows(t, sf))
+
+        stats = {
+            "r_regionkey": CS(5, 0, 4), "r_name": CS(5),
+            "n_nationkey": CS(25, 0, 24), "n_name": CS(25),
+            "n_regionkey": CS(5, 0, 4),
+            "s_suppkey": CS(rows("supplier"), 1, rows("supplier")),
+            "s_nationkey": CS(25, 0, 24),
+            "s_acctbal": CS(rows("supplier") * 0.9, -999.99, 9999.99),
+            "s_name": CS(rows("supplier")),
+            "p_partkey": CS(rows("part"), 1, rows("part")),
+            "p_brand": CS(25), "p_type": CS(150), "p_size": CS(50, 1,
+                                                              50),
+            "p_container": CS(40), "p_mfgr": CS(5),
+            "p_retailprice": CS(rows("part") * 0.1, 900.0, 2099.0),
+            "p_name": CS(rows("part")),
+            "ps_partkey": CS(rows("part"), 1, rows("part")),
+            "ps_suppkey": CS(rows("supplier"), 1, rows("supplier")),
+            "ps_availqty": CS(9999, 1, 9999),
+            "ps_supplycost": CS(100_000, 1.0, 1000.0),
+            "c_custkey": CS(rows("customer"), 1, rows("customer")),
+            "c_nationkey": CS(25, 0, 24), "c_mktsegment": CS(5),
+            "c_acctbal": CS(rows("customer") * 0.9, -999.99, 9999.99),
+            "c_name": CS(rows("customer")),
+            "o_orderkey": CS(rows("orders"), 1, rows("orders") * 4),
+            # 1/3 of customers have no orders (TPC-H 4.2.3)
+            "o_custkey": CS(rows("customer") * 2 / 3, 1,
+                            rows("customer")),
+            "o_orderstatus": CS(3), "o_orderpriority": CS(5),
+            "o_shippriority": CS(1, 0, 0), "o_clerk": CS(
+                max(rows("orders") / 1500, 1)),
+            "o_orderdate": CS(ORDER_DATE_SPAN, STARTDATE,
+                              STARTDATE + ORDER_DATE_SPAN),
+            "o_totalprice": CS(rows("orders") * 0.9, 857.71,
+                               555285.16),
+            "l_orderkey": CS(rows("orders"), 1, rows("orders") * 4),
+            "l_partkey": CS(rows("part"), 1, rows("part")),
+            "l_suppkey": CS(rows("supplier"), 1, rows("supplier")),
+            "l_linenumber": CS(7, 1, 7),
+            "l_quantity": CS(50, 1, 50),
+            "l_extendedprice": CS(rows("part") * 0.5, 901.0,
+                                  104949.5),
+            "l_discount": CS(11, 0.0, 0.10),
+            "l_tax": CS(9, 0.0, 0.08),
+            "l_returnflag": CS(3), "l_linestatus": CS(2),
+            "l_shipmode": CS(7), "l_shipinstruct": CS(4),
+            "l_shipdate": CS(ENDDATE - 151 + 121 - STARTDATE - 1,
+                             STARTDATE + 1, ENDDATE - 151 + 121),
+            "l_commitdate": CS(ENDDATE - STARTDATE, STARTDATE + 30,
+                               ENDDATE - 31),
+            "l_receiptdate": CS(ENDDATE - STARTDATE, STARTDATE + 2,
+                                ENDDATE),
+        }
+        return stats.get(column)
 
     # --- splits ----------------------------------------------------------
     def get_splits(self, handle: TableHandle,

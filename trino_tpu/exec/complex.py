@@ -1018,6 +1018,35 @@ def _map_lambda(which: str):
     return f
 
 
+def top_k_map_entries(col: Column, k: int) -> Column:
+    """Keep each row's k highest-valued entries (value lane descending,
+    key ascending on ties) — the output step of approx_most_frequent
+    (reference: operator/aggregation/approxmostfrequent/)."""
+    cap = col.capacity
+    canon = canonicalize(col, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    counts = _np(canon.elements2.data)[:total].astype(np.int64)
+    klane, _ = _comparable_lane(canon.elements, total)
+    order = np.lexsort((klane, -counts, owner))
+    rank = np.empty(total, np.int64)
+    # rank within owner group over the sorted order
+    so = owner[order]
+    first = np.ones(total, bool)
+    if total > 1:
+        first[1:] = so[1:] != so[:-1]
+    gstart = np.maximum.accumulate(
+        np.where(first, np.arange(total), 0))
+    rank[order] = np.arange(total) - gstart
+    keep = np.sort(order[rank[order] < k])
+    k_owner = owner[keep]
+    lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    return Column(col.type, offs, canon.valid, None, lens,
+                  _take_flat(canon.elements, keep),
+                  _take_flat(canon.elements2, keep))
+
+
 def _map_zip_with(e: Call, batch: Batch) -> Column:
     """map_zip_with(m1, m2, (k, v1, v2) -> ...): key union per row;
     a key absent from one side binds its value parameter to NULL

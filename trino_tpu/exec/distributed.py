@@ -133,7 +133,9 @@ class DistributedExecutor(Executor):
             per_dev[i % n].append(s)
         parts = []
         for d in range(n):
-            batches = [conn.read_split(s, columns) for s in per_dev[d]]
+            from .executor import read_split_cached
+            batches = [read_split_cached(conn, s, columns)
+                       for s in per_dev[d]]
             if not batches:
                 from ..columnar import empty_batch
                 meta = conn.get_table_metadata(node.handle.schema,
@@ -200,7 +202,8 @@ class DistributedExecutor(Executor):
         if not isinstance(src, ShardedBatch):
             return super()._exec_AggregationNode(
                 dc_replace(node, source=_Pre(src)))
-        if any(a.kind in ("array_agg", "map_agg", "histogram")
+        if any(a.kind in ("array_agg", "map_agg", "histogram",
+                          "approx_most_frequent")
                for a in node.aggregates.values()):
             # array/map offsets don't survive shard-local numbering;
             # gather to the coordinator shard and aggregate locally

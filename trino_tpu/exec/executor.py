@@ -172,7 +172,7 @@ class Executor:
 
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
                       "approx_percentile", "array_agg", "map_agg",
-                      "histogram"}
+                      "histogram", "approx_most_frequent"}
 
     def _try_streaming_aggregation(self, node: AggregationNode):
         # kinds whose partials don't combine with a single-lane segment
@@ -215,7 +215,7 @@ class Executor:
         # one jitted program serves every split (uniform capacities)
         run_jit = jax.jit(run) if self.fragment_jit else None
         for sp in splits:
-            raw = conn.read_split(sp, columns)
+            raw = read_split_cached(conn, sp, columns)
             batch = Batch({sym: raw.column(col)
                            for sym, col in cur.assignments.items()},
                           raw.num_rows)
@@ -369,7 +369,7 @@ class Executor:
         columns = sorted(set(node.assignments.values()))
         par = int(self.session.get("task_concurrency")) or 1
         splits = conn.get_splits(node.handle, par)
-        batches = [conn.read_split(s, columns) for s in splits]
+        batches = [read_split_cached(conn, s, columns) for s in splits]
         whole = device_concat(batches) if len(batches) > 1 else batches[0]
         cols = {sym: whole.column(col)
                 for sym, col in node.assignments.items()}
@@ -968,6 +968,103 @@ def _flip_clause(c):
     return JoinClause(c.right, c.left)
 
 
+# --------------------------------------------------------------------------
+# HBM-resident scan cache for immutable generator connectors: the
+# "storage layer" of tpch/tpcds is deterministic, so table columns can
+# live in device memory across queries — on TPU this removes the
+# host->HBM re-upload (the dominant engine-path cost through a tunneled
+# chip; repeated scans become compute-only like the reference's
+# OS-page-cached table files). Keyed per connector object; bounded by
+# CONFIG.scan_cache_bytes, insertion-order eviction.
+# --------------------------------------------------------------------------
+
+import threading as _threading  # noqa: E402
+import weakref as _weakref  # noqa: E402
+
+_SCAN_CACHES: "_weakref.WeakKeyDictionary" = _weakref.WeakKeyDictionary()
+_SCAN_CACHE_LOCK = _threading.Lock()
+
+
+def _col_bytes(c: Column) -> int:
+    total = 0
+    for lane in (c.data, c.valid, c.data2):
+        if lane is not None:
+            total += int(np.asarray(lane).nbytes) \
+                if isinstance(lane, np.ndarray) else int(lane.nbytes)
+    return total
+
+
+def read_split_cached(conn, split, columns) -> Batch:
+    """Split read through the per-connector HBM cache. Lanes are
+    cached per (split, COLUMN), so overlapping projections of the same
+    split share one device copy per column. The lock covers all state
+    mutation — the coordinator runs one executor thread per query."""
+    if not getattr(conn, "scan_cache_ok", False) \
+            or CONFIG.scan_cache_bytes <= 0:
+        return conn.read_split(split, columns)
+    h = split.handle
+    skey = (h.schema, h.table, split.part, split.part_count,
+            h.constraint, h.limit)
+    with _SCAN_CACHE_LOCK:
+        state = _SCAN_CACHES.get(conn)
+        if state is None:
+            state = {"entries": {}, "order": [], "bytes": 0}
+            _SCAN_CACHES[conn] = state
+        entry = state["entries"].get(skey)
+        missing = [c for c in columns
+                   if entry is None or c not in entry["cols"]]
+    if not missing:
+        with _SCAN_CACHE_LOCK:
+            return Batch({c: entry["cols"][c] for c in columns},
+                         entry["num_rows"])
+    raw = conn.read_split(split, missing)
+    on_dev = jax.default_backend() != "cpu"
+    if on_dev:
+        raw = raw.on_device()          # pin the lanes in HBM
+    size = sum(_col_bytes(c) for c in raw.columns.values())
+    with _SCAN_CACHE_LOCK:
+        state = _SCAN_CACHES.get(conn)
+        if state is None:
+            state = {"entries": {}, "order": [], "bytes": 0}
+            _SCAN_CACHES[conn] = state
+        if size <= CONFIG.scan_cache_bytes:
+            while state["bytes"] + size > CONFIG.scan_cache_bytes \
+                    and state["order"]:
+                old_key = state["order"].pop(0)
+                old = state["entries"].pop(old_key, None)
+                if old is not None:
+                    state["bytes"] -= sum(_col_bytes(c)
+                                          for c in old["cols"].values())
+            entry = state["entries"].get(skey)
+            if entry is None:
+                entry = {"cols": {}, "num_rows": raw.num_rows}
+                state["entries"][skey] = entry
+                state["order"].append(skey)
+            for name, col in raw.columns.items():
+                if name not in entry["cols"]:
+                    entry["cols"][name] = col
+                    state["bytes"] += _col_bytes(col)
+        entry = state["entries"].get(skey)
+        if entry is not None and all(c in entry["cols"]
+                                     for c in columns):
+            return Batch({c: entry["cols"][c] for c in columns},
+                         entry["num_rows"])
+    # cache too small for this split: serve the direct read (fill any
+    # columns the raw read didn't cover)
+    if all(c in raw.columns for c in columns):
+        return Batch({c: raw.columns[c] for c in columns},
+                     raw.num_rows)
+    rest = conn.read_split(split, columns)
+    return rest.on_device() if on_dev else rest
+
+
+def _amf_post(sym: str, k: int):
+    def post(out: Batch) -> Column:
+        from .complex import top_k_map_entries
+        return top_k_map_entries(out.column(sym), k)
+    return post
+
+
 def _single_row(src: Batch) -> Batch:
     return Batch({"__one$": Column(
         BIGINT, jnp.zeros((8,), jnp.int64), None)}, 1)
@@ -1054,6 +1151,14 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
                                  input2=a.argument2))
         elif kind == "histogram":
             phys.append(AggInput("histogram", a.argument, a.mask, sym))
+        elif kind == "approx_most_frequent":
+            # exact histogram then keep the k most frequent entries
+            # (reference approximates with a stream summary —
+            # operator/aggregation/approxmostfrequent/; exact is a
+            # correct superset)
+            phys.append(AggInput("histogram", a.argument, a.mask, sym))
+            k = int(a.param) if a.param is not None else 3
+            post[sym] = _amf_post(sym, k)
         elif kind == "approx_percentile":
             phys.append(AggInput("percentile", a.argument, a.mask, sym,
                                  param=a.param))

@@ -25,6 +25,7 @@ AGGREGATE_NAMES = {
     "approx_distinct", "min_by", "max_by", "array_agg", "checksum",
     "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
     "skewness", "kurtosis", "approx_percentile", "map_agg", "histogram",
+    "approx_most_frequent",
 }
 
 WINDOW_ONLY_NAMES = {
@@ -74,6 +75,10 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
     if name == "histogram":
         from .types import MapType
         return MapType(t, BIGINT)
+    if name == "approx_most_frequent":
+        from .types import MapType
+        return MapType(arg_types[1] if len(arg_types) > 1 else t,
+                       BIGINT)
     raise KeyError(f"unknown aggregate: {name}")
 
 

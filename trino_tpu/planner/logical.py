@@ -462,6 +462,28 @@ class LogicalPlanner:
             param = None
             if call.name == "count" and (star or not args):
                 kind, arg_sym, rtype = "count_star", None, BIGINT
+            elif call.name == "approx_most_frequent":
+                # approx_most_frequent(buckets, value[, capacity]):
+                # buckets/capacity are constants, value is the lane
+                kind = call.name
+                if len(args) < 2 or not isinstance(args[0], Const) \
+                        or args[0].value is None:
+                    raise PlanningError(
+                        "approx_most_frequent(buckets, value): buckets "
+                        "must be a constant")
+                param = float(args[0].value)
+                if param < 1:
+                    raise PlanningError(
+                        "approx_most_frequent: buckets must be a "
+                        "positive integer")
+                a1 = args[1]
+                from ..types import MapType
+                rtype = MapType(a1.type, BIGINT)
+                if isinstance(a1, InputRef):
+                    arg_sym = a1.name
+                else:
+                    arg_sym = self.symbols.new(f"{kind}_arg")
+                    pre_assigns[arg_sym] = a1
             else:
                 kind = call.name
                 rtype = aggregate_result_type(kind,

@@ -33,67 +33,208 @@ _OTHER_FACTOR = 0.5
 BROADCAST_ROWS = 1_000_000.0
 
 
-def estimate_rows(node: PlanNode, catalogs: CatalogManager) -> float:
+def estimate_rows(node: PlanNode, catalogs: CatalogManager,
+                  cache: Optional[dict] = None) -> float:
+    rows, _ = derive_stats(node, catalogs,
+                           cache if cache is not None else {})
+    return rows
+
+
+def derive_stats(node: PlanNode, catalogs: CatalogManager,
+                 cache: dict):
+    """(row estimate, {symbol: ColumnStatistics}) per plan node —
+    cost/StatsCalculator's PlanNodeStatsEstimate with per-symbol
+    SymbolStatsEstimate, memoized by node identity."""
+    key = id(node)
+    if key in cache:
+        return cache[key]
+    out = _derive(node, catalogs, cache)
+    cache[key] = out
+    return out
+
+
+def _derive(node, catalogs, cache):
     if isinstance(node, TableScanNode):
         conn = catalogs.connector(node.handle.catalog)
         est = conn.table_row_count(node.handle)
-        return float(est) if est is not None else 10_000.0
+        rows = float(est) if est is not None else 10_000.0
+        cols = {}
+        for sym, col in node.assignments.items():
+            cs = conn.column_statistics(node.handle, col)
+            if cs is not None:
+                cols[sym] = cs
+        # a pushed-down constraint already filtered the scan
+        constraint = getattr(node.handle, "constraint", None)
+        if constraint is not None and not constraint.is_none:
+            for col, dom in constraint.domains:
+                for sym, c in node.assignments.items():
+                    if c == col and sym in cols:
+                        rows *= _domain_selectivity(dom, cols[sym])
+        return max(rows, 1.0), cols
     if isinstance(node, FilterNode):
-        return estimate_rows(node.source, catalogs) * \
-            _selectivity(node.predicate)
-    if isinstance(node, (ProjectNode, SortNode, SampleNode)):
-        return estimate_rows(node.sources[0], catalogs)
+        rows, cols = derive_stats(node.source, catalogs, cache)
+        sel, cols = _filter_stats(node.predicate, cols)
+        return max(rows * sel, 1.0), cols
+    if isinstance(node, ProjectNode):
+        rows, cols = derive_stats(node.source, catalogs, cache)
+        out = {}
+        for sym, e in node.assignments.items():
+            if isinstance(e, InputRef) and e.name in cols:
+                out[sym] = cols[e.name]
+        return rows, out
+    if isinstance(node, (SortNode, SampleNode)):
+        return derive_stats(node.sources[0], catalogs, cache)
     if isinstance(node, (LimitNode, TopNNode)):
-        return min(float(node.count),
-                   estimate_rows(node.sources[0], catalogs))
+        rows, cols = derive_stats(node.sources[0], catalogs, cache)
+        return min(float(node.count), rows), cols
     if isinstance(node, OffsetNode):
-        return max(estimate_rows(node.source, catalogs) - node.count, 0.0)
+        rows, cols = derive_stats(node.source, catalogs, cache)
+        return max(rows - node.count, 0.0), cols
     if isinstance(node, AggregationNode):
-        child = estimate_rows(node.source, catalogs)
+        rows, cols = derive_stats(node.source, catalogs, cache)
         if not node.group_keys:
-            return 1.0
-        return max(child * 0.1, 1.0)
+            return 1.0, {}
+        ndv = 1.0
+        known = True
+        for k in node.group_keys:
+            cs = cols.get(k)
+            if cs is None:
+                known = False
+                break
+            ndv *= max(cs.ndv, 1.0)
+        est = min(ndv, rows) if known else max(rows * 0.1, 1.0)
+        return max(est, 1.0), {k: v for k, v in cols.items()
+                               if k in node.group_keys}
     if isinstance(node, JoinNode):
-        l = estimate_rows(node.left, catalogs)
-        r = estimate_rows(node.right, catalogs)
+        l, lcols = derive_stats(node.left, catalogs, cache)
+        r, rcols = derive_stats(node.right, catalogs, cache)
+        cols = {**lcols, **rcols}
         if node.join_type == "cross" and not node.criteria:
-            return l * r
+            return l * r, cols
+        if node.criteria:
+            # |L ⋈ R| = |L||R| / max(ndv(l_key), ndv(r_key)) per
+            # clause (cost/JoinStatsRule.java's formula)
+            est = l * r
+            for c in node.criteria:
+                la = lcols.get(c.left) or rcols.get(c.left)
+                ra = rcols.get(c.right) or lcols.get(c.right)
+                denom = max((la.ndv if la else 0.0),
+                            (ra.ndv if ra else 0.0), 1.0)
+                if la is None and ra is None:
+                    denom = max(min(l, r) * _EQ_FACTOR, 1.0)
+                est /= denom
+            if node.join_type in ("left", "full"):
+                est = max(est, l)
+            if node.join_type in ("right", "full"):
+                est = max(est, r)
+            return max(est, 1.0), cols
         if node.join_type == "left":
-            return max(l, 1.0)
-        # FK-join assumption: output ~ the larger side
-        return max(l, r)
+            return max(l, 1.0), cols
+        return max(l, r), cols
     if isinstance(node, SemiJoinNode):
-        return estimate_rows(node.source, catalogs)
+        rows, cols = derive_stats(node.source, catalogs, cache)
+        return rows * 0.5, cols
     if isinstance(node, EnforceSingleRowNode):
-        return 1.0
+        return 1.0, {}
     if isinstance(node, ValuesNode):
-        return float(len(node.rows))
+        return float(len(node.rows)), {}
     if isinstance(node, UnionNode):
-        return sum(estimate_rows(c, catalogs) for c in node.children)
+        total = 0.0
+        for c in node.children:
+            rows, _ = derive_stats(c, catalogs, cache)
+            total += rows
+        return total, {}
     if isinstance(node, SetOpNode):
-        return estimate_rows(node.left, catalogs)
+        return derive_stats(node.left, catalogs, cache)
     if node.sources:
-        return estimate_rows(node.sources[0], catalogs)
-    return 1_000.0
+        return derive_stats(node.sources[0], catalogs, cache)
+    return 1_000.0, {}
 
 
-def _selectivity(e) -> float:
+def _domain_selectivity(dom, cs) -> float:
+    """Fraction of a column surviving a pushed TupleDomain domain."""
+    sv = dom.single_values()
+    if sv is not None:
+        return min(len(sv) / max(cs.ndv, 1.0), 1.0)
+    if (cs.min_value is None or cs.max_value is None
+            or not dom.ranges):
+        return _RANGE_FACTOR
+    width = max(cs.max_value - cs.min_value, 1e-9)
+    frac = 0.0
+    for r in dom.ranges:
+        lo = cs.min_value if r.low is None else max(float(r.low),
+                                                    cs.min_value)
+        hi = cs.max_value if r.high is None else min(float(r.high),
+                                                     cs.max_value)
+        frac += max(hi - lo, 0.0) / width
+    return min(max(frac, 1e-4), 1.0)
+
+
+def _filter_stats(e, cols):
+    """(selectivity, updated column stats) for a predicate
+    (cost/FilterStatsCalculator.java: 1/ndv equality, range-fraction
+    comparisons, heuristic fallbacks)."""
     factor = 1.0
+    cols = dict(cols)
     for c in rex.split_conjuncts(e):
-        if isinstance(c, Call):
-            if c.fn == "=":
-                factor *= _EQ_FACTOR
-            elif c.fn in ("<", "<=", ">", ">="):
-                factor *= _RANGE_FACTOR
-            elif c.fn == "like":
-                factor *= _LIKE_FACTOR
-            elif c.fn == "or":
-                factor *= min(_OTHER_FACTOR * 1.5, 1.0)
-            else:
-                factor *= _OTHER_FACTOR
-        else:
-            factor *= _OTHER_FACTOR
-    return max(factor, 1e-4)
+        factor *= _conjunct_selectivity(c, cols)
+    return max(factor, 1e-6), cols
+
+
+def _conjunct_selectivity(c, cols) -> float:
+    if isinstance(c, Call):
+        if c.fn == "=" and len(c.args) == 2:
+            ref, const = _ref_const(c.args)
+            if ref is not None and ref.name in cols:
+                cs = cols[ref.name]
+                cols[ref.name] = type(cs)(1.0, cs.min_value,
+                                          cs.max_value)
+                return 1.0 / max(cs.ndv, 1.0)
+            return _EQ_FACTOR
+        if c.fn in ("<", "<=", ">", ">=") and len(c.args) == 2:
+            ref, const = _ref_const(c.args)
+            if ref is not None and ref.name in cols \
+                    and const is not None:
+                cs = cols[ref.name]
+                if cs.min_value is not None and \
+                        cs.max_value is not None:
+                    try:
+                        v = float(const.value)
+                    except (TypeError, ValueError):
+                        return _RANGE_FACTOR
+                    width = max(cs.max_value - cs.min_value, 1e-9)
+                    op = c.fn if isinstance(c.args[0], InputRef) else \
+                        {"<": ">", "<=": ">=", ">": "<",
+                         ">=": "<="}[c.fn]
+                    if op in ("<", "<="):
+                        frac = (v - cs.min_value) / width
+                    else:
+                        frac = (cs.max_value - v) / width
+                    return min(max(frac, 1e-4), 1.0)
+            return _RANGE_FACTOR
+        if c.fn == "like":
+            return _LIKE_FACTOR
+        if c.fn == "or":
+            return min(_OTHER_FACTOR * 1.5, 1.0)
+        if c.fn == "is_null":
+            ref = c.args[0] if isinstance(c.args[0], InputRef) else None
+            if ref is not None and ref.name in cols:
+                return max(cols[ref.name].null_fraction, 1e-4)
+            return _EQ_FACTOR
+        if c.fn == "not" and isinstance(c.args[0], Call) \
+                and c.args[0].fn == "is_null":
+            return 1.0 - _EQ_FACTOR
+        return _OTHER_FACTOR
+    return _OTHER_FACTOR
+
+
+def _ref_const(args):
+    a, b = args
+    if isinstance(a, InputRef) and isinstance(b, Const):
+        return a, b
+    if isinstance(b, InputRef) and isinstance(a, Const):
+        return b, a
+    return None, None
 
 
 def reorder_joins(node: PlanNode, catalogs: CatalogManager) -> PlanNode:

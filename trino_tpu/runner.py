@@ -168,18 +168,12 @@ class LocalQueryRunner:
         if isinstance(stmt, A.ExecuteStmt):
             return self._execute_prepared(stmt)
         if isinstance(stmt, A.DescribeInput):
-            prep = self.session.prepared.get(stmt.name)
-            if prep is None:
-                raise QueryError(
-                    f"Prepared statement not found: {stmt.name}")
+            prep = self._prepared(stmt.name)
             n = A.count_parameters(prep)
             return QueryResult(["Position", "Type"], [BIGINT, VARCHAR],
                                [[i, "unknown"] for i in range(n)])
         if isinstance(stmt, A.DescribeOutput):
-            prep = self.session.prepared.get(stmt.name)
-            if prep is None:
-                raise QueryError(
-                    f"Prepared statement not found: {stmt.name}")
+            prep = self._prepared(stmt.name)
             if not isinstance(prep, A.QueryStatement):
                 return QueryResult(["Column Name", "Type"],
                                    [VARCHAR, VARCHAR], [])
@@ -381,11 +375,18 @@ class LocalQueryRunner:
             ["Create Table"], [VARCHAR],
             [[f"CREATE TABLE {cat}.{schema}.{name} (\n   {cols}\n)"]])
 
-    def _execute_prepared(self, stmt: A.ExecuteStmt) -> QueryResult:
-        prep = self.session.prepared.get(stmt.name)
+    def _prepared(self, name: str):
+        """Prepared statement by name; header-carried entries are SQL
+        text (X-Trino-Prepared-Statement) and parse lazily."""
+        prep = self.session.prepared.get(name)
         if prep is None:
-            raise QueryError(
-                f"Prepared statement not found: {stmt.name}")
+            raise QueryError(f"Prepared statement not found: {name}")
+        if isinstance(prep, str):
+            prep = parse_statement(prep)
+        return prep
+
+    def _execute_prepared(self, stmt: A.ExecuteStmt) -> QueryResult:
+        prep = self._prepared(stmt.name)
         planner = LogicalPlanner(self.catalogs, self.session)
         values = []
         for p in stmt.params:

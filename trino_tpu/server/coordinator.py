@@ -403,7 +403,12 @@ def _make_handler(co: Coordinator):
         def _authenticate(self) -> bool:
             """HTTP Basic auth against the configured password
             authenticator (server/security/PasswordAuthenticator
-            analog); no authenticator = open access."""
+            analog); no authenticator = open access. On success the
+            verified principal is recorded and MUST match any
+            X-Trino-User header (server/security/
+            AuthenticationFilter + the set-user authorization check) —
+            session identity never comes from an unverified header."""
+            self.principal = None
             if co.authenticator is None:
                 return True
             import base64
@@ -413,6 +418,21 @@ def _make_handler(co: Coordinator):
                     raw = base64.b64decode(header[6:]).decode()
                     user, _, pw = raw.partition(":")
                     if co.authenticator.authenticate(user, pw):
+                        claimed = self.headers.get("X-Trino-User")
+                        if claimed and claimed != user:
+                            body = json.dumps({
+                                "error": f"Access Denied: User {user} "
+                                f"cannot impersonate {claimed}"
+                            }).encode()
+                            self.send_response(403)
+                            self.send_header("Content-Type",
+                                             "application/json")
+                            self.send_header("Content-Length",
+                                             str(len(body)))
+                            self.end_headers()
+                            self.wfile.write(body)
+                            return False
+                        self.principal = user
                         return True
                 except Exception:
                     pass
@@ -436,7 +456,8 @@ def _make_handler(co: Coordinator):
                 session = Session(
                     catalog=self.headers.get("X-Trino-Catalog", "tpch"),
                     schema=self.headers.get("X-Trino-Schema", "tiny"),
-                    user=self.headers.get("X-Trino-User", "user"))
+                    user=(self.principal
+                          or self.headers.get("X-Trino-User", "user")))
                 for kv in (self.headers.get("X-Trino-Session") or "") \
                         .split(","):
                     if "=" in kv:
@@ -445,6 +466,15 @@ def _make_handler(co: Coordinator):
                             session.set(k.strip(), v.strip())
                         except KeyError:
                             pass
+                # client-held prepared statements (sessions are
+                # per-request; the client replays its registry, the
+                # reference's X-Trino-Prepared-Statement contract)
+                from urllib.parse import unquote
+                for kv in (self.headers.get(
+                        "X-Trino-Prepared-Statement") or "").split(","):
+                    if "=" in kv:
+                        name, v = kv.split("=", 1)
+                        session.prepared[name.strip()] = unquote(v)
                 q = co.tracker.submit(
                     sql, session,
                     source=self.headers.get("X-Trino-Source", ""))

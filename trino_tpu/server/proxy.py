@@ -81,8 +81,8 @@ def _make_handler(proxy: Proxy):
                                          method=method)
             for h in ("X-Trino-User", "X-Trino-Catalog",
                       "X-Trino-Schema", "X-Trino-Session",
-                      "X-Trino-Source", "Authorization",
-                      "Content-Type"):
+                      "X-Trino-Source", "X-Trino-Prepared-Statement",
+                      "Authorization", "Content-Type"):
                 if self.headers.get(h):
                     req.add_header(h, self.headers[h])
             try:

@@ -224,7 +224,11 @@ def worker_main(conn, platform: Optional[str] = None):
 
     ``platform`` pins the JAX backend BEFORE anything imports jax — on
     a TPU-attached host a child must not contend for the (exclusive)
-    chip the parent holds; test harnesses pass "cpu"."""
+    chip the parent holds; test harnesses pass "cpu". NOTE: with the
+    'spawn' start method this runs AFTER interpreter startup — a
+    TPU-forcing sitecustomize (PYTHONPATH) executes first and can hang
+    on a dead tunnel, so spawners must ALSO scrub the environment
+    before Process.start() (see spawn_worker_env below)."""
     import os
     if platform:
         os.environ["JAX_PLATFORMS"] = platform
@@ -235,6 +239,31 @@ def worker_main(conn, platform: Optional[str] = None):
     conn.send(srv.port)
     conn.close()
     srv._thread.join()
+
+
+class spawn_worker_env:
+    """Context manager scrubbing the parent environment while spawning
+    CPU-pinned worker children: multiprocessing 'spawn' children run
+    sitecustomize (PYTHONPATH) at interpreter startup, BEFORE
+    worker_main — on a TPU-attached host with a dead tunnel that import
+    blocks forever unless the env is cleaned in the parent first."""
+
+    _KEYS = ("PYTHONPATH", "JAX_PLATFORMS")
+
+    def __enter__(self):
+        import os
+        self._saved = {k: os.environ.get(k) for k in self._KEYS}
+        os.environ["PYTHONPATH"] = ""
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        return self
+
+    def __exit__(self, *exc):
+        import os
+        for k, v in self._saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 class RemoteTaskClient:
