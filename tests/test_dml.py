@@ -1,0 +1,95 @@
+"""UPDATE / MERGE / SHOW STATS.
+
+Reference parity: UpdateOperator + MERGE row-change plans and
+sql/rewrite/ShowStatsRewrite.java, executed against the memory
+connector's swap-contents write path.
+"""
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture()
+def runner():
+    r = LocalQueryRunner()
+    r.execute("CREATE TABLE memory.default.acct AS "
+              "SELECT * FROM (VALUES (1, 'alice', 100.0), "
+              "(2, 'bob', 250.0), (3, 'carol', 0.0), "
+              "(4, 'dan', 75.0)) t(id, name, balance)")
+    return r
+
+
+def rows(r, sql):
+    return r.execute(sql).rows
+
+
+def test_update_where(runner):
+    res = runner.execute(
+        "UPDATE memory.default.acct SET balance = balance + 10 "
+        "WHERE balance < 100")
+    assert res.update_count == 2
+    got = rows(runner, "SELECT id, balance FROM memory.default.acct "
+                       "ORDER BY id")
+    assert got == [[1, 100.0], [2, 250.0], [3, 10.0], [4, 85.0]]
+
+
+def test_update_all_and_multiple_columns(runner):
+    res = runner.execute(
+        "UPDATE memory.default.acct SET balance = 0, name = 'x'")
+    assert res.update_count == 4
+    got = rows(runner, "SELECT DISTINCT name, balance "
+                       "FROM memory.default.acct")
+    assert got == [["x", 0.0]]
+
+
+def test_update_unknown_column(runner):
+    with pytest.raises(Exception, match="does not exist"):
+        runner.execute("UPDATE memory.default.acct SET nope = 1")
+
+
+def test_merge_update_insert_delete(runner):
+    runner.execute(
+        "CREATE TABLE memory.default.delta AS "
+        "SELECT * FROM (VALUES (2, 40.0), (3, -1.0), (9, 500.0)) "
+        "t(id, amount)")
+    res = runner.execute(
+        "MERGE INTO memory.default.acct a "
+        "USING memory.default.delta d ON a.id = d.id "
+        "WHEN MATCHED AND d.amount < 0 THEN DELETE "
+        "WHEN MATCHED THEN UPDATE SET balance = balance + d.amount "
+        "WHEN NOT MATCHED THEN INSERT (id, name, balance) "
+        "VALUES (d.id, 'new', d.amount)")
+    assert res.update_count == 3
+    got = rows(runner, "SELECT id, name, balance "
+                       "FROM memory.default.acct ORDER BY id")
+    assert got == [[1, "alice", 100.0], [2, "bob", 290.0],
+                   [4, "dan", 75.0], [9, "new", 500.0]]
+
+
+def test_merge_not_matched_condition(runner):
+    runner.execute(
+        "CREATE TABLE memory.default.adds AS "
+        "SELECT * FROM (VALUES (7, 5.0), (8, -3.0)) t(id, amount)")
+    res = runner.execute(
+        "MERGE INTO memory.default.acct a "
+        "USING memory.default.adds d ON a.id = d.id "
+        "WHEN NOT MATCHED AND d.amount > 0 THEN "
+        "INSERT (id, name, balance) VALUES (d.id, 'pos', d.amount)")
+    assert res.update_count == 1
+    got = rows(runner, "SELECT id FROM memory.default.acct "
+                       "WHERE id >= 7 ORDER BY id")
+    assert got == [[7]]
+
+
+def test_show_stats(runner):
+    got = rows(runner, "SHOW STATS FOR tpch.tiny.lineitem")
+    by_col = {r[0]: r for r in got}
+    assert None in by_col                      # summary row
+    assert by_col[None][4] > 50000             # row_count estimate
+    qty = by_col["l_quantity"]
+    assert qty[2] == 50.0                      # NDV
+    assert float(qty[5]) == 1.0 and float(qty[6]) == 50.0
+    # memory connector: no stats -> NULL cells, but all columns listed
+    got2 = rows(runner, "SHOW STATS FOR memory.default.acct")
+    assert {r[0] for r in got2} == {"id", "name", "balance", None}

@@ -1,0 +1,128 @@
+"""ORC reader (from scratch) + local-file connector binding.
+
+Reference parity: lib/trino-orc (reader surface). Test files are
+generated with pyarrow.orc — an INDEPENDENT writer — so the reader is
+validated against real third-party output, not a round-trip of itself.
+"""
+
+import datetime
+import os
+
+import numpy as np
+import pytest
+
+pa = pytest.importorskip("pyarrow")
+import pyarrow.orc as po  # noqa: E402
+
+from trino_tpu.connectors.localfile import LocalFileConnector  # noqa
+from trino_tpu.formats.orc import (num_stripes, read_meta, read_orc,
+                                   schema_of)  # noqa: E402
+from trino_tpu.runner import LocalQueryRunner  # noqa: E402
+
+
+N = 4000
+
+
+@pytest.fixture(scope="module")
+def table():
+    rng = np.random.default_rng(11)
+    # `skewed` forces RLEv2 PATCHED_BASE (a few huge outliers over a
+    # narrow base range); `runs` forces SHORT_REPEAT / DELTA
+    skewed = rng.integers(0, 100, N)
+    skewed[::500] = 10**15
+    return pa.table({
+        "id": pa.array(np.arange(N, dtype=np.int64)),
+        "qty": pa.array(rng.integers(0, 50, N).astype(np.int32)),
+        "price": pa.array(np.round(rng.uniform(1.0, 100.0, N), 4)),
+        "flag": pa.array((np.arange(N) % 3 == 0)),
+        "name": pa.array([f"orc_{i % 23}" for i in range(N)]),
+        "maybe": pa.array([None if i % 7 == 0 else i * 11
+                           for i in range(N)], type=pa.int64()),
+        "day": pa.array([datetime.date(2001, 6, 1)
+                         + datetime.timedelta(days=int(i % 900))
+                         for i in range(N)]),
+        "ts": pa.array([datetime.datetime(2022, 5, 6, 7, 8, 9, 250000)
+                        + datetime.timedelta(seconds=int(i))
+                        for i in range(N)], type=pa.timestamp("ms")),
+        "skewed": pa.array(skewed, pa.int64()),
+        "runs": pa.array(np.repeat(np.arange(N // 100), 100)),
+    })
+
+
+@pytest.fixture(scope="module", params=["UNCOMPRESSED", "ZLIB",
+                                        "SNAPPY", "ZSTD"])
+def orc_file(request, table, tmp_path_factory):
+    d = tmp_path_factory.mktemp("orc")
+    path = str(d / f"data_{request.param}.orc")
+    po.write_table(table, path, compression=request.param)
+    return path
+
+
+def test_schema(orc_file):
+    s = schema_of(orc_file)
+    assert str(s["id"]) == "bigint"
+    assert str(s["qty"]) == "integer"
+    assert str(s["price"]) == "double"
+    assert str(s["flag"]) == "boolean"
+    assert str(s["day"]) == "date"
+    assert str(s["ts"]) == "timestamp(3)"
+
+
+def test_full_read_matches_pyarrow(orc_file, table):
+    b = read_orc(orc_file)
+    rows = b.to_pylist()
+    assert len(rows) == N
+    want = table.to_pylist()
+    names = list(b.names)
+    for i in (0, 1, 17, N // 2, N - 1):
+        got = dict(zip(names, rows[i]))
+        for k in ("id", "qty", "flag", "name", "maybe", "day", "ts",
+                  "skewed", "runs"):
+            assert got[k] == want[i][k], (i, k, got[k], want[i][k])
+        assert abs(got["price"] - want[i]["price"]) < 1e-9
+
+
+def test_patched_base_and_runs_whole_column(orc_file, table):
+    b = read_orc(orc_file, columns=["skewed", "runs", "maybe"])
+    sk = [r[0] for r in b.to_pylist()]
+    assert sk == table.column("skewed").to_pylist()
+    rn = [r[1] for r in b.to_pylist()]
+    assert rn == table.column("runs").to_pylist()
+    mb = [r[2] for r in b.to_pylist()]
+    assert mb == table.column("maybe").to_pylist()
+
+
+def test_multi_stripe(table, tmp_path_factory):
+    d = tmp_path_factory.mktemp("orcs")
+    path = str(d / "striped.orc")
+    big = pa.concat_tables([table] * 8)  # exceed one stripe's rows
+    po.write_table(big, path, compression="SNAPPY",
+                   stripe_size=16 * 1024)
+    meta = read_meta(path)
+    assert len(meta.stripes) > 1
+    assert num_stripes(path) == len(meta.stripes)
+    b = read_orc(path)
+    assert [r[0] for r in b.to_pylist()] == list(range(N)) * 8
+    # single-stripe read == that stripe's slice
+    b0 = read_orc(path, stripe_index=0)
+    assert b0.num_rows_host() == meta.stripes[0].num_rows
+
+
+def test_sql_over_orc(table, tmp_path_factory):
+    d = tmp_path_factory.mktemp("orcsql")
+    po.write_table(table, str(d / "events.orc"), compression="ZLIB",
+                   stripe_size=32 * 1024)
+    runner = LocalQueryRunner()
+    runner.catalogs.register("files",
+                             LocalFileConnector(str(d)))
+    rows = runner.execute(
+        "SELECT count(*), sum(qty), min(day), max(name) "
+        "FROM files.default.events").rows
+    want_qty = sum(table.column("qty").to_pylist())
+    assert rows == [[N, want_qty, datetime.date(2001, 6, 1),
+                     "orc_9"]]
+    top = runner.execute(
+        "SELECT name, count(*) c FROM files.default.events "
+        "WHERE maybe IS NOT NULL GROUP BY name "
+        "ORDER BY c DESC, name LIMIT 3").rows
+    assert len(top) == 3

@@ -76,3 +76,76 @@ def test_over_table_rows(runner):
     got = q(runner, "SELECT count(DISTINCT md5(n_name)) FROM "
                     "tpch.tiny.nation")
     assert got == [[25]]
+
+
+def test_regexp_family(runner):
+    assert q(runner,
+             "SELECT regexp_extract('1a 2b 14m', '(\\d+)([a-z]+)', 2), "
+             "regexp_replace('1a 2b 14m', '(\\d+)([a-z]+)', '$2'), "
+             "regexp_extract_all('1a 2b 14m', '\\d+')") == \
+        [["a", "a b m", ["1", "2", "14"]]]
+    assert q(runner, "SELECT regexp_split('one,two,,three', ',')") == \
+        [[["one", "two", "", "three"]]]
+
+
+def test_split_functions(runner):
+    assert q(runner, "SELECT split('a.b.c', '.'), "
+                     "split('a.b.c', '.', 2), "
+                     "split_part('a.b.c', '.', 2)") == \
+        [[["a", "b", "c"], ["a", "b.c"], "b"]]
+    assert q(runner, "SELECT split_to_map('a=1,b=2', ',', '=')") == \
+        [[{"a": "1", "b": "2"}]]
+
+
+def test_array_join(runner):
+    assert q(runner, "SELECT array_join(ARRAY['x','y','z'], '-'), "
+                     "array_join(ARRAY[1, 2, 3], ','), "
+                     "array_join(ARRAY['a', NULL, 'c'], ',', 'N')") == \
+        [["x-y-z", "1,2,3", "a,N,c"]]
+
+
+def test_string_distance_and_misc(runner):
+    assert q(runner,
+             "SELECT levenshtein_distance('kitten', 'sitting'), "
+             "hamming_distance('karolin', 'kathrin'), "
+             "codepoint('A'), chr(66), "
+             "normalize('Å'), "
+             "concat_ws('-', 'a', NULL, 'b')") == \
+        [[3, 3, 65, "B", "Å", "a-b"]]
+
+
+def test_math_constants(runner):
+    import math
+    got = q(runner, "SELECT pi(), e(), atan2(1, 1), "
+                    "width_bucket(5.3, 0.2, 10.6, 5), "
+                    "is_nan(nan()), infinity() > 1e308")[0]
+    assert abs(got[0] - math.pi) < 1e-12
+    assert abs(got[1] - math.e) < 1e-12
+    assert abs(got[2] - math.pi / 4) < 1e-12
+    assert got[3:] == [3, True, True]
+
+
+def test_bases_and_format(runner):
+    assert q(runner, "SELECT to_base(255, 16), from_base('ff', 16), "
+                     "format('%s=%d [%.2f]', 'x', 42, 1.5), "
+                     "format('%,d', 1234567)") == \
+        [["ff", 255, "x=42 [1.50]", "1,234,567"]]
+
+
+def test_typeof_and_time(runner):
+    assert q(runner, "SELECT typeof(1), typeof('x'), typeof(1.5e0)") == \
+        [["integer", "varchar(1)", "double"]]
+    got = q(runner, "SELECT current_date, year(current_date), "
+                    "now() > TIMESTAMP '2020-01-01 00:00:00'")[0]
+    assert got[1] >= 2026 and got[2] is True
+
+
+def test_year_of_week(runner):
+    # 2005-01-01 was a Saturday of ISO week 53 of 2004
+    assert q(runner, "SELECT year_of_week(DATE '2005-01-01'), "
+                     "year_of_week(DATE '2008-12-31')") == [[2004, 2009]]
+
+
+def test_random(runner):
+    got = q(runner, "SELECT random(), random(10) FROM lineitem LIMIT 5")
+    assert all(0.0 <= r[0] < 1.0 and 0 <= r[1] < 10 for r in got)

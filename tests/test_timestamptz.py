@@ -1,0 +1,117 @@
+"""TIMESTAMP WITH TIME ZONE.
+
+Reference parity: spi/type/TimestampWithTimeZoneType.java (instant-
+based equality/ordering; zone kept for display/field extraction) +
+operator/scalar/AtTimeZone.java / DateTimeFunctions.with_timezone.
+"""
+
+import datetime
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.types import parse_type
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def q(runner, sql):
+    return runner.execute(sql).rows
+
+
+def test_parse_type_roundtrip():
+    t = parse_type("timestamp(3) with time zone")
+    assert str(t) == "timestamp(3) with time zone"
+    assert str(parse_type("timestamp with time zone")) == \
+        "timestamp(3) with time zone"
+    assert str(parse_type("timestamp(6) without time zone")) == \
+        "timestamp(6)"
+
+
+def test_literal_and_display(runner):
+    got = q(runner,
+            "SELECT TIMESTAMP '2020-06-01 10:30:00 +05:30'")[0][0]
+    assert got == datetime.datetime(
+        2020, 6, 1, 10, 30,
+        tzinfo=datetime.timezone(datetime.timedelta(hours=5,
+                                                    minutes=30)))
+    # same instant in UTC
+    assert got.astimezone(datetime.timezone.utc).hour == 5
+
+
+def test_instant_equality_across_zones(runner):
+    got = q(runner,
+            "SELECT TIMESTAMP '2020-01-01 12:00:00 +02:00' = "
+            "TIMESTAMP '2020-01-01 10:00:00 UTC', "
+            "TIMESTAMP '2020-01-01 12:00:00 +02:00' < "
+            "TIMESTAMP '2020-01-01 11:00:00 UTC'")
+    assert got == [[True, True]]
+
+
+def test_field_extraction_uses_zone(runner):
+    got = q(runner,
+            "SELECT hour(TIMESTAMP '2020-06-01 23:30:00 -07:00'), "
+            "day(TIMESTAMP '2020-06-01 23:30:00 -07:00'), "
+            "hour(CAST(TIMESTAMP '2020-06-01 23:30:00 -07:00' "
+            "AS timestamp))")
+    # local fields: hour 23, day 1; cast to plain timestamp keeps
+    # the local wall-clock reading
+    assert got == [[23, 1, 23]]
+
+
+def test_at_time_zone(runner):
+    got = q(runner,
+            "SELECT TIMESTAMP '2020-01-01 00:00:00 UTC' "
+            "AT TIME ZONE '+05:30'")[0][0]
+    assert got.utcoffset() == datetime.timedelta(hours=5, minutes=30)
+    assert got.astimezone(datetime.timezone.utc) == \
+        datetime.datetime(2020, 1, 1,
+                          tzinfo=datetime.timezone.utc)
+
+
+def test_with_timezone_and_iso8601(runner):
+    got = q(runner,
+            "SELECT with_timezone(TIMESTAMP '2020-01-01 12:00:00', "
+            "'+02:00'), "
+            "to_iso8601(TIMESTAMP '2020-01-01 12:00:00 +02:00')")
+    wt, iso = got[0]
+    assert wt.astimezone(datetime.timezone.utc).hour == 10
+    assert iso == "2020-01-01T12:00:00.000+02:00"
+
+
+def test_cast_and_order(runner):
+    got = q(runner,
+            "SELECT CAST('2020-03-04 05:06:07' "
+            "AS timestamp with time zone), "
+            "CAST(TIMESTAMP '2020-03-04 23:30:00 -03:00' AS date)")
+    assert got[0][0].astimezone(datetime.timezone.utc) == \
+        datetime.datetime(2020, 3, 4, 5, 6, 7,
+                          tzinfo=datetime.timezone.utc)
+    assert got[0][1] == datetime.date(2020, 3, 4)
+    ordered = q(runner, "SELECT t FROM (VALUES "
+                "TIMESTAMP '2020-01-01 12:00:00 +05:00', "
+                "TIMESTAMP '2020-01-01 12:00:00 +00:00', "
+                "TIMESTAMP '2020-01-01 12:00:00 -03:00') v(t) "
+                "ORDER BY t")
+    instants = [r[0].astimezone(datetime.timezone.utc)
+                for r in ordered]
+    assert instants == sorted(instants)
+
+
+def test_group_by_instant(runner):
+    got = q(runner, "SELECT t, count(*) FROM (VALUES "
+            "TIMESTAMP '2020-01-01 12:00:00 +02:00', "
+            "TIMESTAMP '2020-01-01 10:00:00 UTC', "
+            "TIMESTAMP '2020-01-01 11:00:00 UTC') v(t) "
+            "GROUP BY t ORDER BY 2 DESC")
+    assert [r[1] for r in got] == [2, 1]
+
+
+def test_named_zone(runner):
+    got = q(runner,
+            "SELECT TIMESTAMP '2020-06-01 00:00:00 UTC' "
+            "AT TIME ZONE 'America/New_York'")[0][0]
+    assert got.utcoffset() == datetime.timedelta(hours=-4)  # EDT

@@ -20,33 +20,47 @@ from trino_tpu.session import Session
 # per-table column subsets the suite queries touch (loading every
 # column would mostly exercise to_pylist, not the engine)
 _ORACLE_TABLES = {
-    "date_dim": ["d_date_sk", "d_year", "d_moy"],
-    "item": ["i_item_sk", "i_item_id", "i_product_name", "i_color",
-             "i_current_price", "i_brand_id", "i_brand",
+    "date_dim": ["d_date_sk", "d_date", "d_year", "d_moy", "d_dom",
+                 "d_qoy", "d_dow", "d_month_seq", "d_week_seq",
+                 "d_day_name", "d_quarter_name"],
+    "item": ["i_item_sk", "i_item_id", "i_product_name",
+             "i_item_desc", "i_color", "i_current_price",
+             "i_wholesale_cost", "i_brand_id", "i_brand",
              "i_manufact_id", "i_category_id", "i_category",
-             "i_manager_id"],
+             "i_class_id", "i_class", "i_manager_id"],
     "store_sales": ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
                     "ss_cdemo_sk", "ss_hdemo_sk", "ss_addr_sk",
                     "ss_store_sk", "ss_promo_sk", "ss_ticket_number",
                     "ss_quantity", "ss_wholesale_cost", "ss_list_price",
                     "ss_sales_price", "ss_ext_sales_price",
-                    "ss_coupon_amt"],
-    "store_returns": ["sr_item_sk", "sr_ticket_number"],
+                    "ss_coupon_amt", "ss_net_profit"],
+    "store_returns": ["sr_item_sk", "sr_ticket_number",
+                      "sr_returned_date_sk", "sr_customer_sk",
+                      "sr_store_sk", "sr_return_quantity",
+                      "sr_return_amt", "sr_net_loss"],
     "catalog_sales": ["cs_item_sk", "cs_order_number",
-                      "cs_ext_list_price"],
+                      "cs_ext_list_price", "cs_sold_date_sk",
+                      "cs_bill_customer_sk", "cs_quantity",
+                      "cs_sales_price", "cs_net_profit"],
     "catalog_returns": ["cr_item_sk", "cr_order_number",
                         "cr_refunded_cash", "cr_reversed_charge",
                         "cr_store_credit"],
-    "store": ["s_store_sk", "s_store_name", "s_zip"],
-    "customer": ["c_customer_sk", "c_current_cdemo_sk",
+    "store": ["s_store_sk", "s_store_id", "s_store_name", "s_zip",
+              "s_state", "s_city", "s_number_employees", "s_county",
+              "s_company_name"],
+    "customer": ["c_customer_sk", "c_customer_id",
+                 "c_first_name", "c_last_name", "c_current_cdemo_sk",
                  "c_current_hdemo_sk", "c_current_addr_sk",
                  "c_first_sales_date_sk", "c_first_shipto_date_sk"],
     "customer_demographics": ["cd_demo_sk", "cd_gender",
                               "cd_marital_status",
                               "cd_education_status"],
-    "household_demographics": ["hd_demo_sk", "hd_income_band_sk"],
+    "household_demographics": ["hd_demo_sk", "hd_income_band_sk",
+                               "hd_buy_potential", "hd_dep_count",
+                               "hd_vehicle_count"],
     "customer_address": ["ca_address_sk", "ca_street_number",
-                         "ca_street_name", "ca_city", "ca_zip"],
+                         "ca_street_name", "ca_city", "ca_zip",
+                         "ca_state", "ca_country"],
     "income_band": ["ib_income_band_sk"],
     "promotion": ["p_promo_sk", "p_channel_email", "p_channel_event"],
 }
@@ -98,11 +112,82 @@ def assert_rows_equal(got, want, tag, ordered):
                 assert a == b, f"{tag} row {i}: {a!r} != {b!r}"
 
 
+def to_sqlite(q: str) -> str:
+    """Trino dialect -> sqlite for the TPC-DS texts (DATE literals;
+    integer division is // semantics in sqlite already)."""
+    import re
+    return re.sub(r"DATE\s+'(\d{4}-\d{2}-\d{2})'", r"'\1'", q)
+
+
+# sqlite has no ROLLUP: expand q27 as the UNION ALL of its grouping
+# levels (same semantics per the SQL standard)
+_Q27_BODY = """
+FROM store_sales, customer_demographics, date_dim, store, item
+WHERE ss_sold_date_sk = d_date_sk
+  AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk
+  AND ss_cdemo_sk = cd_demo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND d_year = 2000
+  AND s_state IN ('TN', 'OH', 'TX', 'GA', 'IL')
+"""
+# q48's official text repeats the cd/ca join conjunct inside each OR
+# arm; sqlite's planner cannot extract it and nested-loops for hours.
+# Hoisting the common conjuncts (identical semantics) keeps the oracle
+# tractable; the ENGINE still runs the official OR-embedded form.
+_Q48_ORACLE = """
+SELECT sum(ss_quantity) total
+FROM store_sales, store, customer_demographics, customer_address,
+     date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2000
+  AND cd_demo_sk = ss_cdemo_sk
+  AND ((cd_marital_status = 'M'
+        AND cd_education_status = '4 yr Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00)
+       OR (cd_marital_status = 'D'
+           AND cd_education_status = '2 yr Degree'
+           AND ss_sales_price BETWEEN 50.00 AND 100.00)
+       OR (cd_marital_status = 'S'
+           AND cd_education_status = 'College'
+           AND ss_sales_price BETWEEN 150.00 AND 200.00))
+  AND ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+  AND ((ca_state IN ('CA', 'OH', 'TX')
+        AND ss_net_profit BETWEEN 0 AND 2000)
+       OR (ca_state IN ('OR', 'MN', 'KY')
+           AND ss_net_profit BETWEEN 150 AND 3000)
+       OR (ca_state IN ('VA', 'CA', 'MS')
+           AND ss_net_profit BETWEEN 50 AND 25000))
+"""
+
+_ORACLE_OVERRIDE = {
+    48: _Q48_ORACLE,
+    27: f"""
+SELECT * FROM (
+  SELECT i_item_id, s_state, avg(ss_quantity) agg1,
+         avg(ss_list_price) agg2, avg(ss_coupon_amt) agg3,
+         avg(ss_sales_price) agg4 {_Q27_BODY}
+  GROUP BY i_item_id, s_state
+  UNION ALL
+  SELECT i_item_id, NULL, avg(ss_quantity), avg(ss_list_price),
+         avg(ss_coupon_amt), avg(ss_sales_price) {_Q27_BODY}
+  GROUP BY i_item_id
+  UNION ALL
+  SELECT NULL, NULL, avg(ss_quantity), avg(ss_list_price),
+         avg(ss_coupon_amt), avg(ss_sales_price) {_Q27_BODY})
+ORDER BY i_item_id NULLS LAST, s_state NULLS LAST
+LIMIT 100
+""",
+}
+
+
 @pytest.mark.parametrize("qn", sorted(TPCDS_QUERIES))
 def test_tpcds_local_vs_oracle(local, oracle, qn):
     sql = TPCDS_QUERIES[qn]
     got = [norm_row(r) for r in local.execute(sql).rows]
-    want = [list(r) for r in oracle.execute(sql).fetchall()]
+    osql = to_sqlite(_ORACLE_OVERRIDE.get(qn, sql))
+    want = [list(r) for r in oracle.execute(osql).fetchall()]
     assert_rows_equal(got, want, f"q{qn}", ordered="ORDER BY" in sql)
 
 

@@ -1,4 +1,4 @@
-"""Local-file connector: a directory of parquet/CSV/JSON files as
+"""Local-file connector: a directory of parquet/ORC/CSV/JSON files as
 tables.
 
 Reference parity: plugin/trino-local-file (1.9k loc) generalized with
@@ -22,7 +22,7 @@ from ..catalog import (ColumnMetadata, Connector, Split, TableHandle,
 from ..columnar import Batch, batch_from_pylist
 from ..types import (BIGINT, BOOLEAN, DOUBLE, Type, VARCHAR)
 
-_EXTS = (".parquet", ".csv", ".tsv", ".json", ".ndjson")
+_EXTS = (".parquet", ".orc", ".csv", ".tsv", ".json", ".ndjson")
 
 
 class LocalFileConnector(Connector):
@@ -65,6 +65,9 @@ class LocalFileConnector(Connector):
         if ext == ".parquet":
             from ..formats.parquet import schema_of
             return schema_of(path)
+        if ext == ".orc":
+            from ..formats.orc import schema_of
+            return schema_of(path)
         if ext in (".csv", ".tsv"):
             rows = self._csv_rows(path, limit=100)
             return _infer_schema(rows)
@@ -104,6 +107,10 @@ class LocalFileConnector(Connector):
             from ..formats.parquet import num_row_groups
             n = max(1, num_row_groups(path))
             return [Split(handle, i, n) for i in range(n)]
+        if path and path.lower().endswith(".orc"):
+            from ..formats.orc import num_stripes
+            n = max(1, num_stripes(path))
+            return [Split(handle, i, n) for i in range(n)]
         return [Split(handle, 0, 1)]
 
     # --- data in ---------------------------------------------------------
@@ -124,6 +131,12 @@ class LocalFileConnector(Connector):
             batch = read_parquet(
                 path, columns=need,
                 row_group=split.part if split.part_count > 1 else None)
+        elif ext == ".orc":
+            from ..formats.orc import read_orc
+            batch = read_orc(
+                path, columns=need,
+                stripe_index=split.part if split.part_count > 1
+                else None)
         else:
             rows = (self._csv_rows(path) if ext in (".csv", ".tsv")
                     else self._json_rows(path))
@@ -152,6 +165,9 @@ class LocalFileConnector(Connector):
         if path and path.lower().endswith(".parquet"):
             from ..formats.parquet import read_metadata
             return float(read_metadata(path).num_rows)
+        if path and path.lower().endswith(".orc"):
+            from ..formats.orc import read_meta
+            return float(read_meta(path).num_rows)
         return None
 
 

@@ -279,6 +279,13 @@ class TpcdsConnector(Connector):
                      "Thursday", "Friday", "Saturday"]
             cols["d_day_name"] = _strings(
                 names, ((days + 4) % 7).astype(np.int32), VarcharType(9))
+        if "d_quarter_name" in need:
+            y0, y1 = int(y.min()), int(y.max())
+            vals = [f"{yy}Q{q}" for yy in range(y0, y1 + 1)
+                    for q in range(1, 5)]
+            codes = ((y - y0) * 4 + (moy - 1) // 3).astype(np.int32)
+            cols["d_quarter_name"] = _strings(vals, codes,
+                                              VarcharType(6))
         return self._finish(cols, len(idx), columns)
 
     def _item(self, idx, sf, columns) -> Batch:
@@ -293,6 +300,9 @@ class TpcdsConnector(Connector):
         if "i_product_name" in need:
             cols["i_product_name"] = _word_column(
                 S + 2, idx, _P_NAMES, 4, VarcharType(50))
+        if "i_item_desc" in need:
+            cols["i_item_desc"] = _word_column(
+                S + 13, idx, _P_NAMES, 8, VarcharType(200))
         if "i_color" in need:
             cols["i_color"] = _strings(
                 COLORS,
@@ -310,9 +320,14 @@ class TpcdsConnector(Connector):
                         for b in range(1, 1001)]
                 cols["i_brand"] = _strings(
                     vals, (brand_id - 1).astype(np.int32), VarcharType(50))
-        if "i_manufact_id" in need:
-            cols["i_manufact_id"] = Column(
-                BIGINT, _randint(S + 7, idx, 1, 1000), None)
+        if "i_manufact_id" in need or "i_manufact" in need:
+            mid = _randint(S + 7, idx, 1, 1000)
+            cols["i_manufact_id"] = Column(BIGINT, mid, None)
+            if "i_manufact" in need:
+                vals = [f"{_UNITS[m % 10]}{_UNITS[(m // 10) % 10]}"
+                        for m in range(1, 1001)]
+                cols["i_manufact"] = _strings(
+                    vals, (mid - 1).astype(np.int32), VarcharType(50))
         if "i_category" in need or "i_category_id" in need:
             cid = _randint(S + 8, idx, 1, len(_CATEGORIES))
             cols["i_category_id"] = Column(BIGINT, cid, None)
@@ -485,6 +500,13 @@ class TpcdsConnector(Connector):
             VarcharType(60))
         cols["s_number_employees"] = Column(
             BIGINT, _randint(S + 6, idx, 200, 300), None)
+        cols["s_county"] = _strings(
+            ["Williamson County", "Ziebach County", "Walker County",
+             "Daviess County", "Barrow County"],
+            (_u64(S + 7, idx) % np.uint64(5)).astype(np.int32),
+            VarcharType(30))
+        cols["s_company_name"] = _strings(
+            ["Unknown"], np.zeros(n, np.int32), VarcharType(50))
         return self._finish(cols, n, columns)
 
     def _promotion(self, idx, sf, columns) -> Batch:
@@ -599,8 +621,14 @@ class TpcdsConnector(Connector):
         cols["sr_returned_date_sk"] = Column(
             BIGINT, _randint(S + 2, idx, _SALES_SK_LO, _SALES_SK_HI),
             None)
-        k, v = _fk(S + 3, idx, table_rows("customer", sf), 0.02)
+        # the return references the originating sale's customer and
+        # store (spec: returns come from the matched ticket), so joins
+        # back via (ticket, customer) — q17/q25 — find real matches
+        k, v = _fk(Sss + 4, ticket, table_rows("customer", sf), 0.02)
         cols["sr_customer_sk"] = Column(BIGINT, k, v)
+        if "sr_store_sk" in need:
+            k, v = _fk(Sss + 8, ticket, table_rows("store", sf), 0.02)
+            cols["sr_store_sk"] = Column(BIGINT, k, v)
         qty = _randint(S + 4, idx, 1, 20)
         cols["sr_return_quantity"] = Column(BIGINT, qty, None)
         amt = _price(S + 5, idx, 1.0, 300.0)
@@ -687,13 +715,16 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("d_year", INTEGER), _cm("d_moy", INTEGER),
         _cm("d_dom", INTEGER), _cm("d_qoy", INTEGER),
         _cm("d_dow", INTEGER), _cm("d_month_seq", BIGINT),
-        _cm("d_week_seq", BIGINT), _cm("d_day_name", _V(9))],
+        _cm("d_week_seq", BIGINT), _cm("d_day_name", _V(9)),
+        _cm("d_quarter_name", _V(6))],
     "item": [
         _cm("i_item_sk", BIGINT), _cm("i_item_id", _V(16)),
-        _cm("i_product_name", _V(50)), _cm("i_color", _V(20)),
+        _cm("i_product_name", _V(50)), _cm("i_item_desc", _V(200)),
+        _cm("i_color", _V(20)),
         _cm("i_current_price", DOUBLE), _cm("i_wholesale_cost", DOUBLE),
         _cm("i_brand_id", BIGINT), _cm("i_brand", _V(50)),
-        _cm("i_manufact_id", BIGINT), _cm("i_category_id", BIGINT),
+        _cm("i_manufact_id", BIGINT), _cm("i_manufact", _V(50)),
+        _cm("i_category_id", BIGINT),
         _cm("i_category", _V(50)), _cm("i_class_id", BIGINT),
         _cm("i_class", _V(50)), _cm("i_manager_id", BIGINT),
         _cm("i_size", _V(20)), _cm("i_units", _V(10))],
@@ -728,7 +759,8 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("s_store_sk", BIGINT), _cm("s_store_id", _V(16)),
         _cm("s_store_name", _V(50)), _cm("s_zip", _V(10)),
         _cm("s_state", _V(2)), _cm("s_city", _V(60)),
-        _cm("s_number_employees", BIGINT)],
+        _cm("s_number_employees", BIGINT),
+        _cm("s_county", _V(30)), _cm("s_company_name", _V(50))],
     "promotion": [
         _cm("p_promo_sk", BIGINT), _cm("p_promo_id", _V(16)),
         _cm("p_channel_dmail", _V(1)), _cm("p_channel_email", _V(1)),
@@ -754,7 +786,7 @@ _TABLES: Dict[str, List[CM]] = {
     "store_returns": [
         _cm("sr_item_sk", BIGINT), _cm("sr_ticket_number", BIGINT),
         _cm("sr_returned_date_sk", BIGINT),
-        _cm("sr_customer_sk", BIGINT),
+        _cm("sr_customer_sk", BIGINT), _cm("sr_store_sk", BIGINT),
         _cm("sr_return_quantity", BIGINT),
         _cm("sr_return_amt", DOUBLE), _cm("sr_net_loss", DOUBLE)],
     "catalog_sales": [

@@ -1117,7 +1117,161 @@ def _map_zip_with(e: Call, batch: Batch) -> Column:
                   lens, keys_pool, out_vals)
 
 
+# --------------------------------------------------------------------------
+# string -> array functions (SplitFunction, JoniRegexpFunctions'
+# regexp_extract_all / regexp_split, SplitToMapFunction, ArrayJoin)
+# --------------------------------------------------------------------------
+
+def _mat_strings(col: Column, n: int):
+    from .expr import _materialize_strings
+    return _materialize_strings(col, n)
+
+
+def _strings_array(e: Call, rows) -> Column:
+    """Build an array(varchar) column from per-row python lists
+    (None list -> NULL row; None element -> NULL entry)."""
+    lens = np.asarray([0 if r is None else len(r) for r in rows],
+                      np.int64)
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    flat = [p for r in rows if r is not None for p in r]
+    dct, codes = StringDictionary.from_strings(flat)
+    evalid = np.asarray([p is not None for p in flat], bool)
+    el = Column(VARCHAR, codes,
+                None if evalid.all() else evalid, dct)
+    valid = np.asarray([r is not None for r in rows], bool)
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  lens, el)
+
+
+def _const_arg(e: Call, i: int, what: str):
+    from ..rex import Const as _C
+    if not isinstance(e.args[i], _C):
+        raise _err()(f"{e.name}: {what} must be constant")
+    return e.args[i].value
+
+
+def _split(e: Call, batch: Batch) -> Column:
+    a = _eval(e.args[0], batch)
+    delim = _const_arg(e, 1, "delimiter")
+    limit = (int(_const_arg(e, 2, "limit")) if len(e.args) > 2
+             else None)
+    strs = _mat_strings(a, batch.capacity)
+    rows = []
+    for v in strs:
+        if v is None:
+            rows.append(None)
+        elif limit is not None:
+            rows.append(v.split(delim, limit - 1))
+        else:
+            rows.append(v.split(delim))
+    return _strings_array(e, rows)
+
+
+def _regexp_extract_all(e: Call, batch: Batch) -> Column:
+    import re as _re
+    a = _eval(e.args[0], batch)
+    pat = _re.compile(_const_arg(e, 1, "pattern"))
+    group = int(_const_arg(e, 2, "group")) if len(e.args) > 2 else 0
+    strs = _mat_strings(a, batch.capacity)
+    rows = [None if v is None
+            else [m.group(group) for m in pat.finditer(v)]
+            for v in strs]
+    return _strings_array(e, rows)
+
+
+def _regexp_split(e: Call, batch: Batch) -> Column:
+    import re as _re
+    a = _eval(e.args[0], batch)
+    pat = _re.compile(_const_arg(e, 1, "pattern"))
+    strs = _mat_strings(a, batch.capacity)
+    rows = [None if v is None else pat.split(v) for v in strs]
+    return _strings_array(e, rows)
+
+
+def _split_to_map(e: Call, batch: Batch) -> Column:
+    a = _eval(e.args[0], batch)
+    entry_d = _const_arg(e, 1, "entryDelimiter")
+    kv_d = _const_arg(e, 2, "keyValueDelimiter")
+    strs = _mat_strings(a, batch.capacity)
+    keys, vals = [], []
+    for v in strs:
+        if v is None:
+            keys.append(None)
+            vals.append(None)
+            continue
+        k_row, v_row = [], []
+        for entry in v.split(entry_d):
+            if not entry:
+                continue
+            if kv_d not in entry:
+                raise _err()(
+                    "split_to_map: entry without key-value delimiter")
+            k, val = entry.split(kv_d, 1)
+            if k in k_row:
+                raise _err()(f"split_to_map: duplicate key {k!r}")
+            k_row.append(k)
+            v_row.append(val)
+        keys.append(k_row)
+        vals.append(v_row)
+    karr = _strings_array(e, keys)
+    varr = _strings_array(e, vals)
+    return Column(e.type, karr.data, karr.valid, None, karr.data2,
+                  karr.elements, varr.elements)
+
+
+def _array_join(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    delim = _const_arg(e, 1, "delimiter")
+    null_repl = (_const_arg(e, 2, "null replacement")
+                 if len(e.args) > 2 else None)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    total = _host_int(np.asarray(canon.data2)[:cap].sum())
+    el = canon.elements
+    if is_string(el.type):
+        flat = _mat_strings(el, total)
+    else:
+        d = _np(el.data)[:total]
+        ev = _valid_np(el, total)
+        flat = []
+        for i in range(total):
+            if not ev[i]:
+                flat.append(None)
+            elif d.dtype.kind == "b":
+                flat.append("true" if d[i] else "false")
+            elif d.dtype.kind == "f":
+                flat.append(repr(float(d[i])))
+            else:
+                flat.append(str(int(d[i])))
+    offs = _np(canon.data)[:cap].astype(np.int64)
+    lens = _np(canon.data2)[:cap].astype(np.int64)
+    valid = _valid_np(canon, cap)
+    out = []
+    for i in range(cap):
+        if not valid[i]:
+            out.append(None)
+            continue
+        parts = []
+        for j in range(int(lens[i])):
+            v = flat[int(offs[i]) + j]
+            if v is None:
+                if null_repl is not None:
+                    parts.append(null_repl)
+            else:
+                parts.append(v)
+        out.append(delim.join(parts))
+    dct, codes = StringDictionary.from_strings(out)
+    ovalid = np.asarray([o is not None for o in out], bool)
+    return Column(e.type, codes,
+                  None if ovalid.all() else ovalid, dct)
+
+
 DISPATCH = {
+    "split": _split,
+    "regexp_extract_all": _regexp_extract_all,
+    "regexp_split": _regexp_split,
+    "split_to_map": _split_to_map,
+    "array_join": _array_join,
     "$map": _map_ctor,
     "$row": _row_ctor,
     "$field": _row_field,

@@ -75,18 +75,23 @@ def _const_column(e: Const, cap: int) -> Column:
             from ..columnar import column_from_pylist, pad_batch
             col = column_from_pylist([None], t)
             return pad_batch(Batch({"c": col}, 1), cap).column("c")
-        base = t if t != UNKNOWN else BOOLEAN
-        dt = base.np_dtype or np.dtype(np.int64)
-        col = Column(t, jnp.zeros((cap,), dtype=dt),
-                     jnp.zeros((cap,), dtype=bool))
         if is_string(t):
             d, _ = StringDictionary.from_strings([])
-            col = dc_replace(col, dictionary=d,
-                             data=jnp.zeros((cap,), jnp.int32))
-        return col
+            return Column(t, jnp.zeros((cap,), jnp.int32),
+                          jnp.zeros((cap,), dtype=bool), d)
+        base = t if t != UNKNOWN else BOOLEAN
+        dt = base.np_dtype or np.dtype(np.int64)
+        return Column(t, jnp.zeros((cap,), dtype=dt),
+                      jnp.zeros((cap,), dtype=bool))
     if is_string(t):
         d = StringDictionary(np.asarray([e.value], dtype=object))
         return Column(t, jnp.zeros((cap,), dtype=jnp.int32), None, d)
+    from ..types import TimestampTZType
+    if isinstance(t, TimestampTZType):
+        ms, off = (e.value if isinstance(e.value, tuple)
+                   else (e.value, 0))
+        return Column(t, jnp.full((cap,), ms, jnp.int64), None,
+                      data2=jnp.full((cap,), off, jnp.int64))
     if isinstance(t, DecimalType):
         v = e.value
         q = int(round(float(v) * (10 ** t.scale))) if not isinstance(
@@ -145,9 +150,16 @@ def _dict_transform(col: Column, fn: Callable[[str], object],
 
 def _materialize_strings(col: Column, n: Optional[int] = None) -> List:
     codes = np.asarray(col.data)
-    vals = col.dictionary.values
     valid = (None if col.valid is None else np.asarray(col.valid))
     out = []
+    if col.dictionary is None:
+        # dictionary-less (e.g. an all-NULL UNKNOWN constant): only
+        # invalid rows are representable as strings -> None
+        for i in range(len(codes) if n is None else n):
+            out.append(None if valid is None or not valid[i]
+                       else str(codes[i]))
+        return out
+    vals = col.dictionary.values
     for i in range(len(codes) if n is None else n):
         if valid is not None and not valid[i]:
             out.append(None)
@@ -298,6 +310,23 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
                       src.valid)
     if isinstance(t, TimestampType) and s is DATE:
         return Column(t, d.astype(jnp.int64) * 86400000, src.valid)
+    from ..types import TimestampTZType
+    if isinstance(t, TimestampTZType):
+        if isinstance(s, TimestampType):       # UTC interpretation
+            return Column(t, d.astype(jnp.int64), src.valid,
+                          data2=jnp.zeros((src.capacity,), jnp.int64))
+        if s is DATE:
+            return Column(t, d.astype(jnp.int64) * 86400000, src.valid,
+                          data2=jnp.zeros((src.capacity,), jnp.int64))
+        if isinstance(s, TimestampTZType):
+            return dc_replace(src, type=t)
+    if isinstance(s, TimestampTZType):
+        local = _tz_local_millis(src)
+        if isinstance(t, TimestampType):
+            return Column(t, local, src.valid)
+        if t is DATE:
+            return Column(t, jnp.floor_divide(local, 86400000),
+                          src.valid)
     raise EvalError(f"unsupported cast {s} -> {t}")
 
 
@@ -332,6 +361,14 @@ def _parser_for(t: Type, safe: bool):
             if isinstance(t, TimestampType):
                 from ..types import iso_timestamp_millis
                 return iso_timestamp_millis(v)
+            from ..types import TimestampTZType as _TTZ
+            if isinstance(t, _TTZ):
+                from ..types import iso_timestamp_tz
+                ms, off = iso_timestamp_tz(v)
+                # single int lane from _dict_transform: encode the
+                # UTC instant (offset recovered as 0 — fixed-offset
+                # display is normalized to UTC on this path)
+                return ms if off is None else ms
             from ..types import TimeType as _TT
             if isinstance(t, _TT):
                 from ..types import iso_time_millis
@@ -373,6 +410,26 @@ def _to_varchar(src: Column, t: Type) -> Column:
             out.append("true" if v else "false")
         elif s.name in ("double", "real"):
             out.append(repr(float(v)))
+        elif s.name.endswith("with time zone"):
+            import datetime
+            off = (int(np.asarray(src.data2)[i])
+                   if src.data2 is not None else 0)
+            local = (datetime.datetime(1970, 1, 1)
+                     + datetime.timedelta(
+                         milliseconds=int(v) + off * 60000))
+            sign = "+" if off >= 0 else "-"
+            out.append(local.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3]
+                       + f" {sign}{abs(off) // 60:02d}:"
+                         f"{abs(off) % 60:02d}")
+        elif s.name.startswith("timestamp"):
+            import datetime
+            local = (datetime.datetime(1970, 1, 1)
+                     + datetime.timedelta(milliseconds=int(v)))
+            out.append(local.strftime("%Y-%m-%d %H:%M:%S.%f")[:-3])
+        elif s.name.startswith("time("):
+            ms = int(v) % 86400000
+            out.append(f"{ms // 3600000:02d}:{(ms // 60000) % 60:02d}"
+                       f":{(ms // 1000) % 60:02d}.{ms % 1000:03d}")
         else:
             out.append(str(int(v)))
     d, codes = StringDictionary.from_strings(out)
@@ -906,13 +963,24 @@ def _pad(which):
 
 # ---- datetime ------------------------------------------------------------
 
+def _tz_local_millis(a: Column) -> jax.Array:
+    """UTC instant lane + per-value offset minutes -> local millis."""
+    ms = _lane(a).astype(jnp.int64)
+    if a.data2 is not None:
+        ms = ms + jnp.asarray(a.data2).astype(jnp.int64) * 60000
+    return ms
+
+
 def _extract(field: str):
     def h(e, batch):
+        from ..types import TimestampTZType
         a = eval_expr(e.args[0], batch)
         if a.type is DATE:
             days = _lane(a).astype(jnp.int64)
         elif isinstance(a.type, TimestampType):
             days = jnp.floor_divide(_lane(a), 86400000)
+        elif isinstance(a.type, TimestampTZType):
+            days = jnp.floor_divide(_tz_local_millis(a), 86400000)
         else:
             raise EvalError(f"{field}() requires date/timestamp")
         return Column(BIGINT, extract_field(days, field), a.valid)
@@ -921,12 +989,15 @@ def _extract(field: str):
 
 def _time_field(field: str):
     def h(e, batch):
-        from ..types import TimeType
+        from ..types import TimeType, TimestampTZType
         a = eval_expr(e.args[0], batch)
-        if not isinstance(a.type, (TimestampType, TimeType)):
+        if isinstance(a.type, TimestampTZType):
+            ms = jnp.mod(_tz_local_millis(a), 86400000)
+        elif not isinstance(a.type, (TimestampType, TimeType)):
             return Column(BIGINT, jnp.zeros((batch.capacity,), jnp.int64),
                           a.valid)
-        ms = jnp.mod(_lane(a), 86400000)
+        else:
+            ms = jnp.mod(_lane(a), 86400000)
         if field == "hour":
             v = ms // 3600000
         elif field == "minute":
@@ -1621,7 +1692,437 @@ def _log_b(e, batch):
     return Column(DOUBLE, d, _merge_valid(a, b))
 
 
+def _const_double(val):
+    def f(e, batch):
+        return Column(DOUBLE, jnp.full((batch.capacity,), val,
+                                       jnp.float64), None)
+    return f
+
+
+def _random_fn(e, batch):
+    cap = batch.capacity
+    if e.args:
+        n = eval_expr(e.args[0], batch)
+        bound = np.asarray(_lane(n))
+        vals = np.random.randint(
+            0, np.maximum(bound.astype(np.int64), 1))
+        return Column(BIGINT, jnp.asarray(vals), n.valid)
+    return Column(DOUBLE, jnp.asarray(np.random.uniform(size=cap)), None)
+
+
+def _atan2(e, batch):
+    a = eval_expr(e.args[0], batch)
+    b = eval_expr(e.args[1], batch)
+    d = jnp.arctan2(_lane(a).astype(jnp.float64),
+                    _lane(b).astype(jnp.float64))
+    return Column(DOUBLE, d, _merge_valid(a, b))
+
+
+def _chr(e, batch):
+    a = eval_expr(e.args[0], batch)
+    vals = np.asarray(_lane(a)).astype(np.int64)
+    out = [chr(int(v)) if 0 <= v < 0x110000 else "" for v in vals]
+    dct, codes = StringDictionary.from_strings(out)
+    return Column(e.type, jnp.asarray(codes), a.valid, dct)
+
+
+def _codepoint(e, batch):
+    a = eval_expr(e.args[0], batch)
+    return _dict_transform(
+        a, lambda v: ord(v[0]) if v else None, BIGINT)
+
+
+def _concat_ws(e, batch):
+    """concat_ws(sep, s1, s2, ...): NULL args are skipped; a NULL
+    separator yields NULL (reference: ConcatWsFunction.java)."""
+    cols = [eval_expr(a, batch) for a in e.args]
+    mats = [_materialize_strings(c) for c in cols]
+    out = []
+    for row in zip(*mats):
+        sep = row[0]
+        out.append(None if sep is None
+                   else sep.join(v for v in row[1:] if v is not None))
+    dct, codes = StringDictionary.from_strings(out)
+    valid = np.asarray([o is not None for o in out], dtype=bool)
+    return Column(e.type, jnp.asarray(codes),
+                  None if valid.all() else jnp.asarray(valid), dct)
+
+
+def _java_format_value(spec: str, conv: str, v):
+    """One %-directive of Java String.format, via Python's format
+    mini-language (subset: flags - 0 ,  width, precision; conversions
+    s d f e x o b)."""
+    grouping = "," in spec
+    spec = spec.replace(",", "")
+    align = ""
+    if spec.startswith("-"):
+        align = "<"
+        spec = spec[1:]
+    py = align + spec
+    if conv in ("d", "x", "o"):
+        if conv == "d":
+            return format(int(v), py + (",d" if grouping else "d"))
+        return format(int(v), py + conv)
+    if conv in ("f", "e", "g"):
+        return format(float(v), py + ("," if grouping else "") + conv)
+    if conv == "b":
+        return "true" if v else "false"
+    return format(str(v), py + "s")
+
+
+def _format_fn(e, batch):
+    if not isinstance(e.args[0], Const):
+        raise EvalError("format: the format string must be constant")
+    fmt = e.args[0].value
+    import re as _re
+    parts = _re.split(r"(%[-,0-9.]*[a-zA-Z]|%%)", fmt)
+    cols = [eval_expr(a, batch) for a in e.args[1:]]
+    from ..types import is_string as _iss
+    mats = []
+    for c in cols:
+        if _iss(c.type):
+            mats.append(_materialize_strings(c))
+        else:
+            d = np.asarray(c.data)
+            valid = (np.ones(len(d), bool) if c.valid is None
+                     else np.asarray(c.valid))
+            if isinstance(c.type, DecimalType):
+                hi = (None if c.data2 is None
+                      else np.asarray(c.data2))
+                scale = 10 ** c.type.scale
+
+                def unscale(i):
+                    v = int(d[i])
+                    if hi is not None:
+                        v = (int(hi[i]) << 64) | (v & ((1 << 64) - 1))
+                    return v / scale
+                mats.append([unscale(i) if valid[i] else None
+                             for i in range(len(d))])
+            else:
+                mats.append([d[i].item() if valid[i] else None
+                             for i in range(len(d))])
+    out = []
+    for row in zip(*mats) if mats else [()] * batch.capacity:
+        ai = 0
+        pieces = []
+        bad = False
+        for p in parts:
+            if p == "%%":
+                pieces.append("%")
+            elif p.startswith("%") and len(p) > 1:
+                v = row[ai] if ai < len(row) else None
+                ai += 1
+                if v is None:
+                    bad = True
+                    break
+                pieces.append(_java_format_value(p[1:-1], p[-1], v))
+            else:
+                pieces.append(p)
+        out.append(None if bad else "".join(pieces))
+    dct, codes = StringDictionary.from_strings(out)
+    valid = np.asarray([o is not None for o in out], dtype=bool)
+    return Column(e.type, jnp.asarray(codes),
+                  None if valid.all() else jnp.asarray(valid), dct)
+
+
+def _levenshtein(a: str, b: str) -> int:
+    if len(a) < len(b):
+        a, b = b, a
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        cur = [i]
+        for j, cb in enumerate(b, 1):
+            cur.append(min(prev[j] + 1, cur[j - 1] + 1,
+                           prev[j - 1] + (ca != cb)))
+        prev = cur
+    return prev[-1]
+
+
+def _str_distance(kind):
+    def f(e, batch):
+        a = eval_expr(e.args[0], batch)
+        b = eval_expr(e.args[1], batch)
+        ma, mb = _materialize_strings(a), _materialize_strings(b)
+        out = np.zeros(len(ma), np.int64)
+        valid = np.ones(len(ma), bool)
+        for i, (x, y) in enumerate(zip(ma, mb)):
+            if x is None or y is None:
+                valid[i] = False
+            elif kind == "hamming":
+                if len(x) != len(y):
+                    raise EvalError("hamming_distance: strings must "
+                                    "have the same length")
+                out[i] = sum(c1 != c2 for c1, c2 in zip(x, y))
+            else:
+                out[i] = _levenshtein(x, y)
+        return Column(BIGINT, jnp.asarray(out),
+                      None if valid.all() else jnp.asarray(valid))
+    return f
+
+
+def _regexp_pattern(e, idx=1):
+    if not isinstance(e.args[idx], Const):
+        raise EvalError("regexp pattern must be constant")
+    import re as _re
+    return _re.compile(e.args[idx].value)
+
+
+def _regexp_extract(e, batch):
+    a = eval_expr(e.args[0], batch)
+    pat = _regexp_pattern(e)
+    group = 0
+    if len(e.args) > 2:
+        if not isinstance(e.args[2], Const):
+            raise EvalError("regexp_extract: group must be constant")
+        group = int(e.args[2].value)
+
+    def g(v: str):
+        m = pat.search(v)
+        return None if m is None else m.group(group)
+    return _dict_transform(a, g, e.type)
+
+
+def _regexp_replace(e, batch):
+    import re as _re
+    a = eval_expr(e.args[0], batch)
+    pat = _regexp_pattern(e)
+    repl = ""
+    if len(e.args) > 2:
+        if not isinstance(e.args[2], Const):
+            raise EvalError("regexp_replace: replacement must be "
+                            "constant")
+        # Java replacement syntax: $1 / ${name} -> Python \1 / \g<name>
+        repl = _re.sub(r"\$\{(\w+)\}", r"\\g<\1>",
+                       _re.sub(r"\$(\d+)", r"\\\1", e.args[2].value))
+    return _dict_transform(a, lambda v: pat.sub(repl, v), e.type)
+
+
+def _typeof(e, batch):
+    t = str(e.args[0].type)
+    dct, codes = StringDictionary.from_strings([t] * batch.capacity)
+    return Column(e.type, jnp.asarray(codes), None, dct)
+
+
+def _width_bucket(e, batch):
+    x = eval_expr(e.args[0], batch)
+    lo = eval_expr(e.args[1], batch)
+    hi = eval_expr(e.args[2], batch)
+    n = eval_expr(e.args[3], batch)
+    xd = _lane(x).astype(jnp.float64)
+    lod = _lane(lo).astype(jnp.float64)
+    hid = _lane(hi).astype(jnp.float64)
+    nd = _lane(n).astype(jnp.int64)
+    width = (hid - lod) / nd
+    fwd = jnp.clip(jnp.floor((xd - lod) / width).astype(jnp.int64) + 1,
+                   0, nd + 1)
+    rev = jnp.clip(jnp.floor((lod - xd) /
+                             ((lod - hid) / nd)).astype(jnp.int64) + 1,
+                   0, nd + 1)
+    out = jnp.where(hid >= lod, fwd, rev)
+    return Column(BIGINT, out, _merge_valid(x, lo, hi, n))
+
+
+def _year_of_week(e, batch):
+    """ISO 8601 week-year: the calendar year of the week's Thursday."""
+    a = eval_expr(e.args[0], batch)
+    if a.type is DATE:
+        days = _lane(a).astype(jnp.int64)
+    elif isinstance(a.type, TimestampType):
+        days = jnp.floor_divide(_lane(a), 86400000)
+    else:
+        raise EvalError("year_of_week() requires date/timestamp")
+    monday_idx = jnp.mod(days + 3, 7)          # 0 = Monday
+    thursday = days - monday_idx + 3
+    return Column(BIGINT, extract_field(thursday, "year"), a.valid)
+
+
+def _current_date(e, batch):
+    import time as _time
+    days = int(_time.time() // 86400)
+    return Column(e.type, jnp.full((batch.capacity,), days, jnp.int64),
+                  None)
+
+
+def _now_fn(e, batch):
+    import time as _time
+    ms = int(_time.time() * 1000)
+    return Column(e.type, jnp.full((batch.capacity,), ms, jnp.int64),
+                  None)
+
+
+def _current_time_fn(e, batch):
+    import time as _time
+    ms = int(_time.time() * 1000) % 86400000
+    return Column(e.type, jnp.full((batch.capacity,), ms, jnp.int64),
+                  None)
+
+
+def _date_fn(e, batch):
+    a = eval_expr(e.args[0], batch)
+    return cast_column(a, e.type)
+
+
+def _normalize_fn(e, batch):
+    import unicodedata
+    a = eval_expr(e.args[0], batch)
+    form = "NFC"
+    if len(e.args) > 1:
+        if not isinstance(e.args[1], Const):
+            raise EvalError("normalize: form must be constant")
+        form = str(e.args[1].value).upper()
+    if form not in ("NFC", "NFD", "NFKC", "NFKD"):
+        raise EvalError(f"normalize: invalid form {form}")
+    return _dict_transform(
+        a, lambda v: unicodedata.normalize(form, v), e.type)
+
+
+_BASE_DIGITS = "0123456789abcdefghijklmnopqrstuvwxyz"
+
+
+def _to_base(e, batch):
+    a = eval_expr(e.args[0], batch)
+    r = eval_expr(e.args[1], batch)
+    vals = np.asarray(_lane(a)).astype(np.int64)
+    radix = np.asarray(_lane(r)).astype(np.int64)
+    out = []
+    for v, rx in zip(vals, radix):
+        rx = int(rx)
+        if not 2 <= rx <= 36:
+            raise EvalError("to_base: radix must be in [2, 36]")
+        v = int(v)
+        neg, v = v < 0, abs(v)
+        digits = ""
+        while True:
+            digits = _BASE_DIGITS[v % rx] + digits
+            v //= rx
+            if v == 0:
+                break
+        out.append(("-" if neg else "") + digits)
+    dct, codes = StringDictionary.from_strings(out)
+    return Column(e.type, jnp.asarray(codes), _merge_valid(a, r), dct)
+
+
+def _from_base(e, batch):
+    a = eval_expr(e.args[0], batch)
+    r = eval_expr(e.args[1], batch)
+    if not isinstance(e.args[1], Const):
+        raise EvalError("from_base: radix must be constant")
+    radix = int(e.args[1].value)
+    if not 2 <= radix <= 36:
+        raise EvalError("from_base: radix must be in [2, 36]")
+    return _dict_transform(a, lambda v: int(v, radix), BIGINT)
+
+
+def _zone_offsets_for(zone: str, instants: np.ndarray) -> np.ndarray:
+    """Per-value offset minutes for a zone string; IANA names resolve
+    per instant (DST-correct), fixed offsets are constant."""
+    from ..types import zone_offset_minutes
+    z = zone.strip()
+    if "/" not in z:
+        return np.full(instants.shape, zone_offset_minutes(z), np.int64)
+    import datetime
+    from zoneinfo import ZoneInfo
+    zi = ZoneInfo(z)
+    epoch = datetime.datetime(1970, 1, 1,
+                              tzinfo=datetime.timezone.utc)
+    out = np.empty(instants.shape, np.int64)
+    for i, v in enumerate(instants):
+        off = (epoch + datetime.timedelta(milliseconds=int(v))
+               ).astimezone(zi).utcoffset()
+        out[i] = int(off.total_seconds() // 60)
+    return out
+
+
+def _at_timezone(e, batch):
+    """AT TIME ZONE: same instant, new display zone (reference:
+    operator/scalar/AtTimeZone.java)."""
+    from ..types import TimestampTZType, TimestampType as _TT
+    a = eval_expr(e.args[0], batch)
+    if not isinstance(e.args[1], Const):
+        raise EvalError("AT TIME ZONE: zone must be constant")
+    zone = str(e.args[1].value)
+    if isinstance(a.type, _TT):
+        # plain timestamp: interpret as UTC instant
+        a = dc_replace(a, type=TimestampTZType(a.type.precision),
+                       data2=jnp.zeros((a.capacity,), jnp.int64))
+    instants = np.asarray(a.data)
+    offs = _zone_offsets_for(zone, instants)
+    return dc_replace(a, data2=jnp.asarray(offs))
+
+
+def _with_timezone(e, batch):
+    """with_timezone(timestamp, zone): the wall-clock value read in
+    that zone (instant shifts)."""
+    from ..types import TimestampTZType
+    a = eval_expr(e.args[0], batch)
+    if not isinstance(e.args[1], Const):
+        raise EvalError("with_timezone: zone must be constant")
+    zone = str(e.args[1].value)
+    local = np.asarray(a.data)
+    offs = _zone_offsets_for(zone, local)  # approx for DST edges
+    instant = local - offs * 60000
+    return Column(TimestampTZType(getattr(a.type, "precision", 3)),
+                  jnp.asarray(instant), a.valid,
+                  data2=jnp.asarray(offs))
+
+
+def _to_iso8601(e, batch):
+    from ..types import TimestampTZType
+    a = eval_expr(e.args[0], batch)
+    import datetime
+    epoch = datetime.datetime(1970, 1, 1)
+    vals = np.asarray(a.data)
+    out = []
+    if a.type is DATE:
+        d0 = datetime.date(1970, 1, 1).toordinal()
+        for v in vals:
+            out.append(datetime.date.fromordinal(int(v) + d0)
+                       .isoformat())
+    elif isinstance(a.type, TimestampTZType):
+        offs = (np.asarray(a.data2) if a.data2 is not None
+                else np.zeros(len(vals), np.int64))
+        for v, o in zip(vals, offs):
+            local = epoch + datetime.timedelta(
+                milliseconds=int(v) + int(o) * 60000)
+            sign = "+" if o >= 0 else "-"
+            out.append(local.isoformat(timespec="milliseconds")
+                       + f"{sign}{abs(int(o)) // 60:02d}:"
+                         f"{abs(int(o)) % 60:02d}")
+    else:
+        for v in vals:
+            out.append((epoch + datetime.timedelta(milliseconds=int(v))
+                        ).isoformat(timespec="milliseconds"))
+    dct, codes = StringDictionary.from_strings(out)
+    return Column(VARCHAR, jnp.asarray(codes), a.valid, dct)
+
+
 _DISPATCH_EXTRA = {
+    "at_timezone": _at_timezone,
+    "with_timezone": _with_timezone,
+    "to_iso8601": _to_iso8601,
+    "pi": _const_double(float(np.pi)),
+    "e": _const_double(float(np.e)),
+    "nan": _const_double(float("nan")),
+    "infinity": _const_double(float("inf")),
+    "random": _random_fn, "rand": _random_fn,
+    "atan2": _atan2,
+    "chr": _chr, "codepoint": _codepoint,
+    "concat_ws": _concat_ws,
+    "format": _format_fn,
+    "hamming_distance": _str_distance("hamming"),
+    "levenshtein_distance": _str_distance("levenshtein"),
+    "regexp_extract": _regexp_extract,
+    "regexp_replace": _regexp_replace,
+    "typeof": _typeof,
+    "width_bucket": _width_bucket,
+    "year_of_week": _year_of_week, "yow": _year_of_week,
+    "current_date": _current_date,
+    "now": _now_fn, "current_timestamp": _now_fn,
+    "localtimestamp": _now_fn,
+    "current_time": _current_time_fn, "localtime": _current_time_fn,
+    "date": _date_fn,
+    "normalize": _normalize_fn,
+    "to_base": _to_base, "from_base": _from_base,
     "bitwise_and": _bitwise("and"), "bitwise_or": _bitwise("or"),
     "bitwise_xor": _bitwise("xor"),
     "bitwise_left_shift": _bitwise("lshift"),

@@ -1,0 +1,618 @@
+"""From-scratch ORC reader producing columnar Batches.
+
+Reference parity: lib/trino-orc (29.3k loc — the largest lib module:
+OrcReader.java:66,251, the typed stream readers under reader/, the
+RLEv1/v2 + boolean decoders under stream/). Nothing delegates to
+pyarrow — the protobuf tail parser, compression-chunk framing, byte/
+boolean RLE, integer RLEv1 + all four RLEv2 sub-encodings, and the
+typed column readers live here; numpy does the wide decodes so every
+column lands as a dense lane ready for device upload (same TPU-first
+angle as formats/parquet.py).
+
+Supported surface (flat schemas — a root STRUCT of primitive fields):
+- types BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING,
+  VARCHAR, CHAR, DATE, TIMESTAMP, DECIMAL(p<=18), BINARY (as varchar)
+- encodings DIRECT, DIRECT_V2, DICTIONARY_V2 (+ byte/boolean RLE)
+- codecs NONE, ZLIB (raw deflate), SNAPPY, ZSTD, LZ4 (error)
+- nulls via PRESENT bit streams; multiple stripes concatenated
+
+The protobuf tail is decoded with a minimal wire-format reader (the
+schema constants below mirror orc_proto.proto field numbers).
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..columnar import Batch, Column, StringDictionary, pad_batch
+from ..config import capacity_for
+from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL,
+                     SMALLINT, TINYINT, DecimalType, TimestampType, Type,
+                     VarcharType, CharType, VARCHAR)
+
+MAGIC = b"ORC"
+
+# orc_proto.proto CompressionKind
+_NONE, _ZLIB, _SNAPPY, _LZO, _LZ4, _ZSTD = range(6)
+
+# orc_proto.proto Type.Kind
+(K_BOOLEAN, K_BYTE, K_SHORT, K_INT, K_LONG, K_FLOAT, K_DOUBLE, K_STRING,
+ K_BINARY, K_TIMESTAMP, K_LIST, K_MAP, K_STRUCT, K_UNION, K_DECIMAL,
+ K_DATE, K_VARCHAR, K_CHAR) = range(18)
+
+# Stream.Kind
+(S_PRESENT, S_DATA, S_LENGTH, S_DICTIONARY_DATA, S_DICTIONARY_COUNT,
+ S_SECONDARY, S_ROW_INDEX, S_BLOOM_FILTER) = range(8)
+
+# ColumnEncoding.Kind
+E_DIRECT, E_DICTIONARY, E_DIRECT_V2, E_DICTIONARY_V2 = range(4)
+
+# ORC timestamp epoch: 2015-01-01 00:00:00 UTC, seconds
+_TS_EPOCH = 1420070400
+
+
+# --------------------------------------------------------------------------
+# minimal protobuf wire-format reader
+# --------------------------------------------------------------------------
+
+def _varint(buf: bytes, pos: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def pb_decode(buf: bytes) -> Dict[int, list]:
+    """Wire-level decode: {field_number: [raw values]} — varints stay
+    ints, length-delimited stay bytes (decoded further by the caller)."""
+    out: Dict[int, list] = {}
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        tag, pos = _varint(buf, pos)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, pos = _varint(buf, pos)
+        elif wt == 1:
+            v = struct.unpack_from("<Q", buf, pos)[0]
+            pos += 8
+        elif wt == 2:
+            ln, pos = _varint(buf, pos)
+            v = buf[pos:pos + ln]
+            pos += ln
+        elif wt == 5:
+            v = struct.unpack_from("<I", buf, pos)[0]
+            pos += 4
+        else:
+            raise ValueError(f"orc: unsupported protobuf wire type {wt}")
+        out.setdefault(fno, []).append(v)
+    return out
+
+
+def _packed_uints(vals: list) -> List[int]:
+    """A repeated uint field arrives either as N varints or as packed
+    length-delimited bytes."""
+    out: List[int] = []
+    for v in vals:
+        if isinstance(v, int):
+            out.append(v)
+        else:
+            pos = 0
+            while pos < len(v):
+                u, pos = _varint(v, pos)
+                out.append(u)
+    return out
+
+
+# --------------------------------------------------------------------------
+# compression framing
+# --------------------------------------------------------------------------
+
+def _decompress_block(kind: int, data: bytes) -> bytes:
+    if kind == _ZLIB:
+        return zlib.decompress(data, -15)
+    if kind == _SNAPPY:
+        from .parquet import snappy_decompress
+        return snappy_decompress(data)
+    if kind == _ZSTD:
+        try:
+            from compression import zstd  # py3.14 stdlib
+            return zstd.decompress(data)
+        except ImportError:
+            try:
+                import zstandard
+                return zstandard.ZstdDecompressor().decompress(data)
+            except ImportError:
+                raise ValueError(
+                    "orc: zstd codec requires the zstandard module")
+    raise ValueError(f"orc: unsupported compression kind {kind}")
+
+
+def _read_stream(raw: bytes, kind: int) -> bytes:
+    """Un-frame an ORC compressed stream: 3-byte chunk headers of
+    (length << 1 | isOriginal), little-endian."""
+    if kind == _NONE:
+        return raw
+    out = bytearray()
+    pos = 0
+    n = len(raw)
+    while pos + 3 <= n:
+        h = raw[pos] | (raw[pos + 1] << 8) | (raw[pos + 2] << 16)
+        pos += 3
+        ln = h >> 1
+        chunk = raw[pos:pos + ln]
+        pos += ln
+        out += chunk if h & 1 else _decompress_block(kind, chunk)
+    return bytes(out)
+
+
+# --------------------------------------------------------------------------
+# RLE decoders
+# --------------------------------------------------------------------------
+
+def _zigzag(u: np.ndarray) -> np.ndarray:
+    u = u.astype(np.uint64)
+    return ((u >> np.uint64(1)) ^ (-(u & np.uint64(1))).astype(
+        np.uint64)).astype(np.int64)
+
+
+def _byte_rle(buf: bytes, count: int) -> np.ndarray:
+    """Byte-level RLE (used for PRESENT/boolean bit streams and BYTE)."""
+    out = np.empty(count, np.uint8)
+    got = 0
+    pos = 0
+    while got < count and pos < len(buf):
+        ctrl = buf[pos]
+        pos += 1
+        if ctrl < 128:                  # run
+            run = ctrl + 3
+            out[got:got + run] = buf[pos]
+            pos += 1
+            got += run
+        else:                           # literals
+            lit = 256 - ctrl
+            out[got:got + lit] = np.frombuffer(
+                buf, np.uint8, lit, pos)
+            pos += lit
+            got += lit
+    return out[:count]
+
+
+def _bool_bits(buf: bytes, count: int) -> np.ndarray:
+    by = _byte_rle(buf, (count + 7) // 8)
+    bits = np.unpackbits(by)  # MSB first
+    return bits[:count].astype(bool)
+
+
+def _sleb128(buf: bytes, pos: int) -> Tuple[int, int]:
+    """Signed varint (used by RLEv1 base and DECIMAL mantissas):
+    unbounded zigzag."""
+    u, pos = _varint(buf, pos)
+    return (u >> 1) ^ -(u & 1), pos
+
+
+_WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
+                17, 18, 19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48,
+                56, 64]
+
+
+def _unpack_bits(buf: bytes, pos: int, count: int, width: int
+                 ) -> Tuple[np.ndarray, int]:
+    """MSB-first bit unpacking of `count` values of `width` bits."""
+    nbytes = (count * width + 7) // 8
+    bits = np.unpackbits(np.frombuffer(buf, np.uint8, nbytes, pos))
+    bits = bits[:count * width].reshape(count, width).astype(np.uint64)
+    weights = (np.uint64(1) << np.arange(width - 1, -1, -1,
+                                         dtype=np.uint64))
+    return bits @ weights, pos + nbytes
+
+
+def _rle_v2(buf: bytes, count: int, signed: bool) -> np.ndarray:
+    """Integer RLEv2: SHORT_REPEAT / DIRECT / PATCHED_BASE / DELTA."""
+    chunks: List[np.ndarray] = []
+    got = 0
+    pos = 0
+    while got < count and pos < len(buf):
+        first = buf[pos]
+        enc = first >> 6
+        if enc == 0:                    # SHORT_REPEAT
+            nbytes = ((first >> 3) & 0x7) + 1
+            run = (first & 0x7) + 3
+            val = int.from_bytes(buf[pos + 1:pos + 1 + nbytes], "big")
+            pos += 1 + nbytes
+            if signed:
+                val = (val >> 1) ^ -(val & 1)
+            chunks.append(np.full(run, val, np.int64))
+            got += run
+        elif enc == 1:                  # DIRECT
+            width = _WIDTH_TABLE[(first >> 1) & 0x1F]
+            ln = ((first & 1) << 8 | buf[pos + 1]) + 1
+            pos += 2
+            vals, pos = _unpack_bits(buf, pos, ln, width)
+            v = _zigzag(vals) if signed else vals.astype(np.int64)
+            chunks.append(v)
+            got += ln
+        elif enc == 2:                  # PATCHED_BASE
+            width = _WIDTH_TABLE[(first >> 1) & 0x1F]
+            ln = ((first & 1) << 8 | buf[pos + 1]) + 1
+            third, fourth = buf[pos + 2], buf[pos + 3]
+            bw = (third >> 5) + 1       # base width, bytes
+            pw = _WIDTH_TABLE[third & 0x1F]
+            pgw = (fourth >> 5) + 1     # patch gap width, bits
+            pll = fourth & 0x1F         # patch list length
+            pos += 4
+            base = int.from_bytes(buf[pos:pos + bw], "big")
+            sign_mask = 1 << (bw * 8 - 1)
+            if base & sign_mask:        # sign-magnitude
+                base = -(base & (sign_mask - 1))
+            pos += bw
+            vals, pos = _unpack_bits(buf, pos, ln, width)
+            if pll:
+                patch, pos = _unpack_bits(buf, pos, pll, pgw + pw)
+                idx = 0
+                for p in patch:
+                    gap = int(p) >> pw
+                    pv = int(p) & ((1 << pw) - 1)
+                    idx += gap
+                    vals[idx] = vals[idx] | (np.uint64(pv) << np.uint64(
+                        width))
+            chunks.append(vals.astype(np.int64) + base)
+            got += ln
+        else:                           # DELTA
+            wcode = (first >> 1) & 0x1F
+            ln = ((first & 1) << 8 | buf[pos + 1]) + 1
+            pos += 2
+            if signed:
+                base, pos = _sleb128(buf, pos)
+            else:
+                base, pos = _varint(buf, pos)
+            delta0, pos = _sleb128(buf, pos)
+            out = np.empty(ln, np.int64)
+            out[0] = base
+            if ln > 1:
+                out[1] = base + delta0
+            if ln > 2:
+                if wcode == 0:          # fixed delta
+                    deltas = np.full(ln - 2, delta0, np.int64)
+                else:
+                    width = _WIDTH_TABLE[wcode]
+                    dv, pos = _unpack_bits(buf, pos, ln - 2, width)
+                    deltas = dv.astype(np.int64)
+                    if delta0 < 0:
+                        deltas = -deltas
+                out[2:] = out[1] + np.cumsum(deltas)
+            chunks.append(out)
+            got += ln
+    vals = (np.concatenate(chunks) if chunks
+            else np.zeros(0, np.int64))
+    return vals[:count]
+
+
+def _rle_v1(buf: bytes, count: int, signed: bool) -> np.ndarray:
+    chunks: List[np.ndarray] = []
+    got = 0
+    pos = 0
+    while got < count and pos < len(buf):
+        ctrl = buf[pos]
+        pos += 1
+        if ctrl < 128:                  # run
+            run = ctrl + 3
+            delta = struct.unpack_from("b", buf, pos)[0]
+            pos += 1
+            if signed:
+                base, pos = _sleb128(buf, pos)
+            else:
+                base, pos = _varint(buf, pos)
+            chunks.append(base + delta * np.arange(run, dtype=np.int64))
+            got += run
+        else:
+            lit = 256 - ctrl
+            vals = np.empty(lit, np.int64)
+            for i in range(lit):
+                if signed:
+                    vals[i], pos = _sleb128(buf, pos)
+                else:
+                    v, pos = _varint(buf, pos)
+                    vals[i] = v
+            chunks.append(vals)
+            got += lit
+    vals = (np.concatenate(chunks) if chunks
+            else np.zeros(0, np.int64))
+    return vals[:count]
+
+
+def _read_ints(buf: bytes, count: int, signed: bool,
+               encoding: int) -> np.ndarray:
+    if encoding in (E_DIRECT_V2, E_DICTIONARY_V2):
+        return _rle_v2(buf, count, signed)
+    return _rle_v1(buf, count, signed)
+
+
+# --------------------------------------------------------------------------
+# file metadata
+# --------------------------------------------------------------------------
+
+@dataclass
+class OrcType:
+    kind: int
+    subtypes: List[int] = field(default_factory=list)
+    field_names: List[str] = field(default_factory=list)
+    max_length: int = 0
+    precision: int = 0
+    scale: int = 0
+
+
+@dataclass
+class StripeInfo:
+    offset: int
+    index_length: int
+    data_length: int
+    footer_length: int
+    num_rows: int
+
+
+@dataclass
+class OrcMeta:
+    compression: int
+    types: List[OrcType]
+    stripes: List[StripeInfo]
+    num_rows: int
+
+
+def read_meta(path: str) -> OrcMeta:
+    with open(path, "rb") as f:
+        data = f.read()
+    if len(data) < 4 or not data.startswith(MAGIC):
+        raise ValueError(f"{path}: not an ORC file")
+    ps_len = data[-1]
+    ps = pb_decode(data[-1 - ps_len:-1])
+    footer_len = ps[1][0]
+    compression = ps.get(2, [_NONE])[0]
+    footer_raw = data[-1 - ps_len - footer_len:-1 - ps_len]
+    footer = pb_decode(_read_stream(footer_raw, compression))
+    types: List[OrcType] = []
+    for raw in footer.get(4, []):
+        t = pb_decode(raw)
+        types.append(OrcType(
+            kind=t.get(1, [K_STRUCT])[0],
+            subtypes=_packed_uints(t.get(2, [])),
+            field_names=[b.decode() for b in t.get(3, [])],
+            max_length=t.get(4, [0])[0],
+            precision=t.get(5, [0])[0],
+            scale=t.get(6, [0])[0]))
+    stripes = []
+    for raw in footer.get(3, []):
+        s = pb_decode(raw)
+        stripes.append(StripeInfo(
+            s.get(1, [0])[0], s.get(2, [0])[0], s.get(3, [0])[0],
+            s.get(4, [0])[0], s.get(5, [0])[0]))
+    return OrcMeta(compression, types, stripes,
+                   footer.get(6, [0])[0])
+
+
+def _sql_type(t: OrcType) -> Type:
+    if t.kind == K_BOOLEAN:
+        return BOOLEAN
+    if t.kind == K_BYTE:
+        return TINYINT
+    if t.kind == K_SHORT:
+        return SMALLINT
+    if t.kind == K_INT:
+        return INTEGER
+    if t.kind == K_LONG:
+        return BIGINT
+    if t.kind == K_FLOAT:
+        return REAL
+    if t.kind == K_DOUBLE:
+        return DOUBLE
+    if t.kind in (K_STRING, K_BINARY):
+        return VARCHAR
+    if t.kind == K_VARCHAR:
+        return VarcharType(t.max_length or None)
+    if t.kind == K_CHAR:
+        return CharType(t.max_length or 1)
+    if t.kind == K_DATE:
+        return DATE
+    if t.kind == K_TIMESTAMP:
+        return TimestampType(3)
+    if t.kind == K_DECIMAL:
+        p = t.precision or 38
+        if p > 18:
+            raise ValueError("orc: DECIMAL precision > 18 unsupported")
+        return DecimalType(p, t.scale)
+    raise ValueError(f"orc: unsupported type kind {t.kind}")
+
+
+def schema_of(path: str) -> Dict[str, Type]:
+    meta = read_meta(path)
+    root = meta.types[0]
+    if root.kind != K_STRUCT:
+        raise ValueError("orc: root type must be a struct")
+    return {name: _sql_type(meta.types[sub])
+            for name, sub in zip(root.field_names, root.subtypes)}
+
+
+# --------------------------------------------------------------------------
+# stripe reading
+# --------------------------------------------------------------------------
+
+def _column_from_streams(t: OrcType, sql: Type, n: int, enc: int,
+                         dict_size: int, streams: Dict[int, bytes]
+                         ) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                    Optional[list], Optional[np.ndarray]]:
+    """Returns (values, valid, dict_strings, data2) with `values` dense
+    over n rows (nulls zero-filled)."""
+    present = streams.get(S_PRESENT)
+    valid = _bool_bits(present, n) if present is not None else None
+    nnz = int(valid.sum()) if valid is not None else n
+
+    def scatter(vals: np.ndarray, fill=0) -> np.ndarray:
+        if valid is None:
+            return vals
+        out = np.full(n, fill, vals.dtype)
+        out[valid] = vals[:nnz]
+        return out
+
+    data = streams.get(S_DATA, b"")
+    if t.kind == K_BOOLEAN:
+        return scatter(_bool_bits(data, nnz)), valid, None, None
+    if t.kind == K_BYTE:
+        return (scatter(_byte_rle(data, nnz).astype(np.int8)
+                        .astype(np.int64)), valid, None, None)
+    if t.kind in (K_SHORT, K_INT, K_LONG, K_DATE):
+        return (scatter(_read_ints(data, nnz, True, enc)), valid,
+                None, None)
+    if t.kind == K_FLOAT:
+        vals = np.frombuffer(data, "<f4", nnz).astype(np.float32)
+        return scatter(vals), valid, None, None
+    if t.kind == K_DOUBLE:
+        vals = np.frombuffer(data, "<f8", nnz)
+        return scatter(vals), valid, None, None
+    if t.kind == K_TIMESTAMP:
+        secs = _read_ints(data, nnz, True, enc) + _TS_EPOCH
+        nraw = _read_ints(streams.get(S_SECONDARY, b""), nnz, False, enc)
+        z = nraw & 7
+        nanos = np.where(z == 0, nraw >> 3,
+                         (nraw >> 3) * 10 ** (z + 1).astype(np.int64))
+        # negative seconds with nonzero nanos count backwards
+        secs = np.where((secs < 0) & (nanos != 0), secs - 1, secs)
+        ms = secs * 1000 + nanos // 1_000_000
+        return scatter(ms), valid, None, None
+    if t.kind == K_DECIMAL:
+        mant = np.empty(nnz, np.int64)
+        pos = 0
+        for i in range(nnz):
+            mant[i], pos = _sleb128(data, pos)
+        scales = _read_ints(streams.get(S_SECONDARY, b""), nnz, True,
+                            enc)
+        target = t.scale
+        adj = target - scales
+        mant = (mant * np.power(10, np.clip(adj, 0, None))
+                // np.power(10, np.clip(-adj, 0, None)))
+        return scatter(mant), valid, None, None
+    if t.kind in (K_STRING, K_VARCHAR, K_CHAR, K_BINARY):
+        if enc in (E_DICTIONARY, E_DICTIONARY_V2):
+            codes = _read_ints(data, nnz, False, enc)
+            lens = _read_ints(streams.get(S_LENGTH, b""), dict_size,
+                              False, enc)
+            blob = streams.get(S_DICTIONARY_DATA, b"")
+            offs = np.concatenate([[0], np.cumsum(lens)])
+            words = [blob[offs[i]:offs[i + 1]].decode("utf-8", "replace")
+                     for i in range(dict_size)]
+            strs = [words[int(c)] if dict_size else "" for c in codes]
+        else:
+            lens = _read_ints(streams.get(S_LENGTH, b""), nnz, False,
+                              enc)
+            offs = np.concatenate([[0], np.cumsum(lens)])
+            strs = [data[offs[i]:offs[i + 1]].decode("utf-8", "replace")
+                    for i in range(nnz)]
+        full: List[Optional[str]]
+        if valid is None:
+            full = strs
+        else:
+            full = [None] * n
+            j = 0
+            for i in range(n):
+                if valid[i]:
+                    full[i] = strs[j]
+                    j += 1
+        return np.zeros(n, np.int32), valid, full, None
+    raise ValueError(f"orc: unsupported column kind {t.kind}")
+
+
+def read_orc(path: str, columns: Optional[Sequence[str]] = None,
+             stripe_index: Optional[int] = None) -> Batch:
+    """Read an ORC file (or one stripe of it) into a host Batch."""
+    meta = read_meta(path)
+    root = meta.types[0]
+    names = root.field_names
+    want = set(columns) if columns is not None else set(names)
+    with open(path, "rb") as f:
+        data = f.read()
+
+    stripes = (meta.stripes if stripe_index is None
+               else [meta.stripes[stripe_index]])
+    per_col_vals: Dict[str, list] = {c: [] for c in names if c in want}
+    per_col_valid: Dict[str, list] = {c: [] for c in names if c in want}
+    per_col_strs: Dict[str, list] = {c: [] for c in names if c in want}
+    any_null: Dict[str, bool] = {c: False for c in names if c in want}
+
+    for st in stripes:
+        sf_off = st.offset + st.index_length + st.data_length
+        sfoot = pb_decode(_read_stream(
+            data[sf_off:sf_off + st.footer_length], meta.compression))
+        streams = []
+        for raw in sfoot.get(1, []):
+            s = pb_decode(raw)
+            streams.append((s.get(1, [0])[0], s.get(2, [0])[0],
+                            s.get(3, [0])[0]))
+        encodings = []
+        for raw in sfoot.get(2, []):
+            e = pb_decode(raw)
+            encodings.append((e.get(1, [0])[0], e.get(2, [0])[0]))
+        # stream byte ranges: cumulative from stripe start, index
+        # streams included
+        pos = st.offset
+        col_streams: Dict[int, Dict[int, bytes]] = {}
+        for kind, col, length in streams:
+            if kind not in (S_ROW_INDEX, S_BLOOM_FILTER):
+                col_streams.setdefault(col, {})[kind] = _read_stream(
+                    data[pos:pos + length], meta.compression)
+            pos += length
+        for fi, (name, ci) in enumerate(zip(names, root.subtypes)):
+            if name not in want:
+                continue
+            t = meta.types[ci]
+            sql = _sql_type(t)
+            enc, dsz = (encodings[ci] if ci < len(encodings)
+                        else (E_DIRECT_V2, 0))
+            vals, valid, strs, d2 = _column_from_streams(
+                t, sql, st.num_rows, enc, dsz,
+                col_streams.get(ci, {}))
+            per_col_vals[name].append(vals)
+            per_col_valid[name].append(
+                valid if valid is not None
+                else np.ones(st.num_rows, bool))
+            if valid is not None:
+                any_null[name] = True
+            if strs is not None:
+                per_col_strs[name].extend(strs)
+
+    total = sum(st.num_rows for st in stripes)
+    cols: Dict[str, Column] = {}
+    by_name = dict(zip(names, root.subtypes))
+    ordered = (list(columns) if columns is not None else names)
+    for name in ordered:
+        ci = by_name[name]
+        if name not in want:
+            continue
+        sql = _sql_type(meta.types[ci])
+        if per_col_strs[name]:
+            dct, codes = StringDictionary.from_strings(
+                per_col_strs[name])
+            valid = (np.asarray([s is not None
+                                 for s in per_col_strs[name]])
+                     if any_null[name] else None)
+            cols[name] = Column(sql, codes, valid, dct)
+        else:
+            vals = (np.concatenate(per_col_vals[name])
+                    if per_col_vals[name] else np.zeros(0, np.int64))
+            valid = (np.concatenate(per_col_valid[name])
+                     if any_null[name] else None)
+            cols[name] = Column(sql, vals, valid)
+    b = Batch(cols, total)
+    return pad_batch(b, capacity_for(max(total, 1)))
+
+
+def num_stripes(path: str) -> int:
+    return len(read_meta(path).stripes)

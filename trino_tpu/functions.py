@@ -176,6 +176,13 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "regexp_like": lambda n, a: BOOLEAN,
     "regexp_replace": _varchar_fn,
     "regexp_extract": _varchar_fn,
+    "regexp_extract_all": lambda n, a: _mk_array(VARCHAR),
+    "regexp_split": lambda n, a: _mk_array(VARCHAR),
+    "split": lambda n, a: _mk_array(VARCHAR),
+    "split_to_map": lambda n, a: _split_to_map_type(),
+    "normalize": _varchar_fn,
+    "to_base": _varchar_fn,
+    "from_base": _bigint_fn,
     "format": _varchar_fn,
     # datetime (operator/scalar/DateTimeFunctions.java)
     "year": _bigint_fn, "quarter": _bigint_fn, "month": _bigint_fn,
@@ -191,13 +198,19 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "date": lambda n, a: DATE,
     "current_date": lambda n, a: DATE,
     "now": lambda n, a: TimestampType(3),
+    "current_timestamp": lambda n, a: TimestampType(3),
+    "localtimestamp": lambda n, a: TimestampType(3),
+    "current_time": lambda n, a: _time_type(),
+    "localtime": lambda n, a: _time_type(),
     "from_unixtime": lambda n, a: TimestampType(3),
     "to_unixtime": lambda n, a: DOUBLE,
     "date_format": _varchar_fn,
     "date_parse": lambda n, a: TimestampType(3),
+    "at_timezone": lambda n, a: _tstz(a),
+    "with_timezone": lambda n, a: _tstz(a),
+    "to_iso8601": _varchar_fn,
     # misc
     "typeof": _varchar_fn,
-    "hash_counts": _bigint_fn,
     "to_hex": _varchar_fn,
     "from_hex": lambda n, a: VARCHAR,
     "xxhash64": _bigint_fn,
@@ -280,6 +293,22 @@ def _map_of(name, args):
 def _mk_array(t):
     from .types import ArrayType
     return ArrayType(t)
+
+
+def _time_type():
+    from .types import TimeType
+    return TimeType(3)
+
+
+def _tstz(args):
+    from .types import TimestampTZType
+    p = getattr(args[0], "precision", 3) if args else 3
+    return TimestampTZType(p)
+
+
+def _split_to_map_type():
+    from .types import MapType
+    return MapType(VARCHAR, VARCHAR)
 
 
 def _map_ctor(name, args):

@@ -59,8 +59,11 @@ def _key_lanes(batch: Batch, key_names: Sequence[str],
     for name in key_names:
         col = batch.column(name)
         col_lanes = equality_lanes(col.data)
-        if col.data2 is not None:
-            # Int128 high lane participates in key equality
+        if col.data2 is not None and not str(col.type.name).endswith(
+                "with time zone"):
+            # Int128 high lane participates in key equality; a
+            # TIMESTAMP WITH TIME ZONE's zone lane does NOT (equality
+            # is instant-based, reference TimestampWithTimeZoneType)
             col_lanes = col_lanes + equality_lanes(col.data2)
         if col.valid is not None:
             v = jnp.asarray(col.valid)
@@ -156,8 +159,21 @@ def _packed_group_aggregate(batch: Batch, key_names: Sequence[str],
             code = jnp.where(jnp.asarray(c.valid), code, d)
         packed = packed * (d + 1) + code
 
-    gmasks = [live & (packed == g) for g in range(nseg)]
-    counts = jnp.stack([jnp.sum(m.astype(jnp.int64)) for m in gmasks])
+    # Pallas fast path (TPU): one fused one-hot-matmul pass computes
+    # every float sum + count; other kinds keep the masked reductions
+    from . import pallas_groupby as _pg
+    pmode = _pg.mode()
+    pallas_res: Dict[str, Column] = {}
+    rest: List[AggInput] = list(aggs)
+    counts = None
+    if pmode:
+        pallas_res, rest, counts = _pallas_packed_aggs(
+            batch, aggs, packed, live, nseg, pmode)
+    gmasks = ([live & (packed == g) for g in range(nseg)]
+              if (rest or counts is None) else [])
+    if counts is None:
+        counts = jnp.stack([jnp.sum(m.astype(jnp.int64))
+                            for m in gmasks])
 
     out_cols: Dict[str, Column] = {}
     # key columns decoded from the group index (after compaction below)
@@ -179,11 +195,87 @@ def _packed_group_aggregate(batch: Batch, key_names: Sequence[str],
     out_cols = {k: out_cols[k] for k in key_names}
 
     gidx_c = jnp.clip(gidx, 0, nseg - 1)
+    rest_set = {id(a) for a in rest}
     for agg in aggs:
-        res = _masked_agg(batch, agg, gmasks, live, nseg)
+        if id(agg) in rest_set:
+            res = _masked_agg(batch, agg, gmasks, live, nseg)
+        else:
+            res = pallas_res[agg.output]
         out_cols[agg.output] = _compact_groups(res, gidx_c)
 
     return Batch(out_cols, num_groups)
+
+
+def _agg_row_mask(batch: Batch, agg: AggInput,
+                  live: jax.Array) -> jax.Array:
+    m = live
+    if agg.mask is not None:
+        mcol = batch.column(agg.mask)
+        mm = jnp.asarray(mcol.data).astype(bool)
+        if mcol.valid is not None:
+            mm = mm & jnp.asarray(mcol.valid)
+        m = m & mm
+    return m
+
+
+def _pallas_packed_aggs(batch: Batch, aggs: Sequence[AggInput],
+                        packed: jax.Array, live: jax.Array, nseg: int,
+                        mode: str):
+    """Route float sums and counts through the pallas grouped-sum
+    kernel (ops/pallas_groupby.py). Returns (results by output name as
+    [nseg] Columns, remaining aggs, per-group live counts)."""
+    from ..types import BIGINT
+    from . import pallas_groupby as _pg
+
+    lanes: List[jax.Array] = [live.astype(jnp.float64)]
+    plans = []          # (agg, kind, value_idx, count_idx, col)
+    rest: List[AggInput] = []
+    for agg in aggs:
+        if agg.kind in ("count_star", "count"):
+            m = _agg_row_mask(batch, agg, live)
+            col = None
+            if agg.kind == "count":
+                col = batch.column(agg.input)
+                if col.valid is not None:
+                    m = m & jnp.asarray(col.valid)
+            plans.append((agg, "count", len(lanes), None, col))
+            lanes.append(m.astype(jnp.float64))
+            continue
+        if agg.kind == "sum":
+            col = batch.column(agg.input)
+            vals = jnp.asarray(col.data)
+            if col.data2 is None and vals.dtype in (jnp.float32,
+                                                    jnp.float64):
+                m = _agg_row_mask(batch, agg, live)
+                if col.valid is not None:
+                    m = m & jnp.asarray(col.valid)
+                plans.append((agg, "sum", len(lanes), len(lanes) + 1,
+                              col))
+                lanes.append(jnp.where(m, vals.astype(jnp.float64),
+                                       0.0))
+                lanes.append(m.astype(jnp.float64))
+                continue
+        rest.append(agg)
+    if not plans:
+        return {}, list(aggs), None
+
+    gid = jnp.where(live, packed, _pg.G_PAD).astype(jnp.int32)
+    outs = _pg.grouped_sums(gid, lanes, nseg,
+                            interpret=(mode == "interpret"))
+    counts = jnp.round(outs[0]).astype(jnp.int64)
+    results: Dict[str, Column] = {}
+    for agg, kind, vi, ci, col in plans:
+        if kind == "count":
+            results[agg.output] = Column(
+                BIGINT, jnp.round(outs[vi]).astype(jnp.int64), None)
+        else:
+            nvalid = jnp.round(outs[ci]).astype(jnp.int64)
+            data = outs[vi]
+            if jnp.asarray(col.data).dtype == jnp.float32:
+                data = data.astype(jnp.float32)
+            results[agg.output] = Column(_sum_type(col.type), data,
+                                         nvalid > 0)
+    return results, rest, counts
 
 
 def _compact_groups(col: Column, gidx: jax.Array) -> Column:

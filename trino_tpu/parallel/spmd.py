@@ -46,12 +46,20 @@ def _shard_repartition(cols: Dict[str, Column], my_n: jax.Array,
                                               jax.Array]:
     """Per-shard: hash-bin rows by destination, all_to_all, compact.
     Returns (received columns [out_cap], my new row count)."""
+    h = hash_columns([cols[k] for k in key_names])
+    pid = (h % jnp.uint64(n_dev)).astype(jnp.int32)
+    return _shard_exchange(cols, my_n, pid, n_dev, out_cap)
+
+
+def _shard_exchange(cols: Dict[str, Column], my_n: jax.Array,
+                    pid: jax.Array, n_dev: int,
+                    out_cap: int) -> Tuple[Dict[str, Column], jax.Array]:
+    """Per-shard exchange body: given each row's destination shard id,
+    bin rows, all_to_all, compact. The received rows preserve
+    (source-shard, source-position) order within each destination."""
     some = next(iter(cols.values()))
     per = int(some.data.shape[0])
     live = jnp.arange(per, dtype=jnp.int64) < my_n
-
-    h = hash_columns([cols[k] for k in key_names])
-    pid = (h % jnp.uint64(n_dev)).astype(jnp.int32)
     sort_key = jnp.where(live, pid, n_dev)
     order = jnp.argsort(sort_key, stable=True)
 
@@ -151,6 +159,130 @@ def repartition_by_hash(sb: ShardedBatch, key_names: Sequence[str],
         check_vma=False)
     cols, counts = fn(sb.columns, sb.num_rows)
     return ShardedBatch(cols, counts, mesh, cap)
+
+
+# --------------------------------------------------------------------------
+# range repartition (distributed sort / merge-exchange analog)
+# --------------------------------------------------------------------------
+
+def _range_pid(batch: Batch, sort_keys, splitter_lanes) -> jax.Array:
+    """Destination shard id per row: the number of splitters whose
+    composite sort-lane tuple is strictly below the row's. Splitters
+    ascend, so shard ids ascend with ORDER BY position — shard-major
+    concatenation of per-shard sorted rows IS the global order."""
+    from ..ops.sort import sort_lanes
+    lanes = sort_lanes(batch, sort_keys)[1:]  # drop the liveness lane
+    some = lanes[0]
+    dest = jnp.zeros(some.shape, jnp.int32)
+    n_split = len(splitter_lanes[0])
+    for si in range(n_split):
+        gt = jnp.zeros(some.shape, bool)
+        eq = jnp.ones(some.shape, bool)
+        for lane, sl in zip(lanes, splitter_lanes):
+            sval = jnp.asarray(sl[si], dtype=lane.dtype)
+            gt = gt | (eq & (lane > sval))
+            eq = eq & (lane == sval)
+        dest = dest + gt.astype(jnp.int32)
+    return dest
+
+
+def sample_range_splitters(sb: ShardedBatch, sort_keys,
+                           samples_per_shard: int = 256):
+    """Phase 0 of a distributed sort: evenly sample each shard's sort
+    lanes, gather the samples, and pick n_dev-1 splitters at sample
+    quantiles (the reference's sampled range partitioning for
+    distributed_sort / MergeOperator's range exchange). Returns a list
+    of per-lane splitter value arrays, or None when the relation is
+    empty."""
+    import numpy as np
+    from ..ops.sort import sort_lanes
+    n = sb.n_shards
+    S = samples_per_shard
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        b = Batch(cols, my_n)
+        lanes = sort_lanes(b, sort_keys)[1:]
+        pos = (jnp.arange(S, dtype=jnp.int64)
+               * jnp.maximum(my_n, 1)) // S
+        samp = tuple(
+            jnp.take(l, jnp.clip(pos, 0, l.shape[0] - 1), mode="clip")
+            for l in lanes)
+        live = jnp.arange(S, dtype=jnp.int64) < my_n
+        return samp + (live,)
+
+    # out_specs needs the lane count up front; derive it from a tiny
+    # 8-row head batch so no full-column lane computation runs here
+    head = {name: Column(c.type, jnp.asarray(c.data)[:8],
+                         None if c.valid is None
+                         else jnp.asarray(c.valid)[:8], c.dictionary)
+            for name, c in sb.columns.items()}
+    n_lanes_probe = len(sort_lanes(Batch(head, 0), sort_keys)) - 1
+
+    g = shard_map(f, mesh=sb.mesh,
+                  in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                  out_specs=tuple([P(AXIS)] * (n_lanes_probe + 1)),
+                  check_vma=False)
+    out = g(sb.columns, sb.num_rows)
+    live = np.asarray(out[-1])
+    if not live.any():
+        return None
+    lanes_h = [np.asarray(l)[live] for l in out[:-1]]
+    order = np.lexsort(lanes_h[::-1])
+    m = len(order)
+    picks = [order[min(((i + 1) * m) // n, m - 1)] for i in range(n - 1)]
+    return [l[picks] for l in lanes_h]
+
+
+def range_dest_counts(sb: ShardedBatch, sort_keys,
+                      splitter_lanes) -> jax.Array:
+    """Per-destination row totals for a range exchange (two-phase
+    capacity sizing, mirroring repartition_dest_counts)."""
+    n = sb.n_shards
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        some = next(iter(cols.values()))
+        per = int(some.data.shape[0])
+        live = jnp.arange(per, dtype=jnp.int64) < my_n
+        pid = _range_pid(Batch(cols, my_n), sort_keys, splitter_lanes)
+        counts = jax.ops.segment_sum(
+            live.astype(jnp.int64), jnp.clip(pid, 0, n - 1),
+            num_segments=n)
+        return jax.lax.psum(counts, AXIS)
+
+    g = shard_map(f, mesh=sb.mesh,
+                  in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+                  out_specs=P(),
+                  check_vma=False)
+    return g(sb.columns, sb.num_rows)
+
+
+def repartition_by_range(sb: ShardedBatch, sort_keys, splitter_lanes,
+                         out_cap: Optional[int] = None) -> ShardedBatch:
+    """Range exchange: redistribute rows so shard i holds the i-th
+    ORDER BY slice. A per-shard sort afterwards yields a globally
+    sorted relation under shard-major gather (unshard_batch)."""
+    n = sb.n_shards
+    cap = out_cap or n * sb.per_shard_cap
+
+    def f(cols, num_rows_vec):
+        d = jax.lax.axis_index(AXIS)
+        my_n = num_rows_vec[d]
+        pid = _range_pid(Batch(cols, my_n), sort_keys, splitter_lanes)
+        out, new_n = _shard_exchange(cols, my_n, pid, n, cap)
+        counts = jax.lax.all_gather(new_n, AXIS)
+        return out, counts
+
+    fn = shard_map(
+        f, mesh=sb.mesh,
+        in_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+        out_specs=(_col_specs(sb.columns, P(AXIS)), P()),
+        check_vma=False)
+    cols, counts = fn(sb.columns, sb.num_rows)
+    return ShardedBatch(cols, counts, sb.mesh, cap)
 
 
 def distributed_group_aggregate(sb: ShardedBatch,

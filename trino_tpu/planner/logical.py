@@ -1328,8 +1328,13 @@ def _rewrite_expr(self: LogicalPlanner, e: A.Expression,
             return Call("not", (arg,), BOOLEAN)
         if e.op == "-":
             if isinstance(arg, Const) and is_numeric(arg.type):
-                return Const(-arg.value if arg.value is not None else None,
-                             arg.type)
+                v = arg.value
+                if v is None:
+                    return Const(None, arg.type)
+                if isinstance(v, str):   # decimal literals carry text
+                    from decimal import Decimal
+                    return Const(str(-Decimal(v)), arg.type)
+                return Const(-v, arg.type)
             return Call("negate", (arg,), arg.type)
         return arg
     if isinstance(e, A.IsNull):
@@ -1685,8 +1690,16 @@ def _plan_literal(e: A.Literal) -> Const:
             return Const(d.toordinal()
                          - datetime.date(1970, 1, 1).toordinal(), DATE)
         if isinstance(t, TimestampType):
-            from ..types import iso_timestamp_millis
-            return Const(iso_timestamp_millis(str(v)), t)
+            from ..types import TimestampTZType, iso_timestamp_tz
+            ms, off = iso_timestamp_tz(str(v))
+            if off is None:
+                return Const(ms, t)
+            return Const((ms, off), TimestampTZType(t.precision))
+        from ..types import TimestampTZType as _TTZ
+        if isinstance(t, _TTZ):
+            from ..types import iso_timestamp_tz
+            ms, off = iso_timestamp_tz(str(v))
+            return Const((ms, off or 0), t)
         from ..types import TimeType as _TimeType
         if isinstance(t, _TimeType):
             from ..types import iso_time_millis

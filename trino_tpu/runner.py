@@ -154,6 +154,8 @@ class LocalQueryRunner:
             return _msg_result("DROP VIEW")
         if isinstance(stmt, A.ShowCreate):
             return self._show_create(stmt)
+        if isinstance(stmt, A.ShowStats):
+            return self._show_stats(stmt)
         if isinstance(stmt, A.Describe):
             return self._dispatch(A.ShowColumns(stmt.table))
         if isinstance(stmt, A.Prepare):
@@ -300,6 +302,10 @@ class LocalQueryRunner:
             return self._insert(stmt)
         if isinstance(stmt, A.Delete):
             return self._delete(stmt)
+        if isinstance(stmt, A.Update):
+            return self._update(stmt)
+        if isinstance(stmt, A.Merge):
+            return self._merge_stmt(stmt)
         raise QueryError(
             f"statement {type(stmt).__name__} not supported")
 
@@ -354,6 +360,39 @@ class LocalQueryRunner:
         except KeyError as e:
             raise QueryError(str(e).strip('"')) from e
         return _msg_result("CREATE VIEW")
+
+    def _show_stats(self, stmt: "A.ShowStats") -> QueryResult:
+        """SHOW STATS FOR table (reference: sql/rewrite/
+        ShowStatsRewrite.java) — one row per column from the
+        connector's ColumnStatistics plus the row-count summary row."""
+        from .types import DOUBLE
+        cat, schema, name = self._qualify(stmt.table)
+        conn = self.catalogs.connector(cat)
+        meta = conn.get_table_metadata(schema, name)
+        if meta is None:
+            raise QueryError(
+                f"Table '{cat}.{schema}.{name}' does not exist")
+        handle = TableHandle(cat, schema, name)
+        rows_est = conn.table_row_count(handle)
+        out = []
+        for c in meta.columns:
+            cs = conn.column_statistics(handle, c.name)
+            if cs is None:
+                out.append([c.name, None, None, None, None, None,
+                            None])
+                continue
+            fmt = (lambda v: None if v is None else str(v))
+            out.append([c.name, None, float(cs.ndv),
+                        float(cs.null_fraction), None,
+                        fmt(cs.min_value), fmt(cs.max_value)])
+        out.append([None, None, None, None,
+                    None if rows_est is None else float(rows_est),
+                    None, None])
+        return QueryResult(
+            ["column_name", "data_size", "distinct_values_count",
+             "nulls_fraction", "row_count", "low_value", "high_value"],
+            [VARCHAR, DOUBLE, DOUBLE, DOUBLE, DOUBLE, VARCHAR,
+             VARCHAR], out)
 
     def _show_create(self, stmt: A.ShowCreate) -> QueryResult:
         cat, schema, name = self._qualify(stmt.name)
@@ -486,6 +525,176 @@ class LocalQueryRunner:
             data, {c.name: c.type for c in meta.columns})
         conn.replace(schema, table, batch)
         return _msg_result("DELETE", int(total) - len(survivors.rows))
+
+    def _writable_meta(self, cat: str, schema: str, table: str,
+                       what: str):
+        conn = self.catalogs.connector(cat)
+        meta = conn.get_table_metadata(schema, table)
+        if meta is None:
+            raise QueryError(
+                f"Table '{cat}.{schema}.{table}' does not exist")
+        if not hasattr(conn, "replace"):
+            raise QueryError(f"{conn.name}: {what} not supported")
+        return conn, meta
+
+    def _update(self, stmt: "A.Update") -> QueryResult:
+        """UPDATE as whole-table rewrite: every column becomes
+        CASE WHEN pred THEN cast(assignment) ELSE old END (reference:
+        UpdateOperator + connector row change; the memory connector
+        swaps contents like DELETE above)."""
+        cat, schema, table = self._qualify(stmt.table)
+        self._check_access("update", cat, schema, table)
+        conn, meta = self._writable_meta(cat, schema, table, "UPDATE")
+        names = {c.name for c in meta.columns}
+        assigns = {}
+        for col, e in stmt.assignments:
+            if col.lower() not in names:
+                raise QueryError(f"Column '{col}' does not exist")
+            assigns[col.lower()] = e
+        cond = (A.FunctionCall("coalesce",
+                               (stmt.where, A.Literal(False)))
+                if stmt.where is not None else A.Literal(True))
+        items = []
+        for c in meta.columns:
+            if c.name in assigns:
+                items.append(A.SelectItem(
+                    A.Case(((cond, A.Cast(assigns[c.name],
+                                          str(c.type))),),
+                           A.Identifier((c.name,))), c.name))
+            else:
+                items.append(A.SelectItem(A.Identifier((c.name,)),
+                                          c.name))
+        items.append(A.SelectItem(cond, "__updated"))
+        res = self._run_query(A.QueryStatement(A.Query(
+            A.QuerySpecification(
+                tuple(items), from_=A.Table((cat, schema, table))))))
+        data = {c.name: [row[i] for row in res.rows]
+                for i, c in enumerate(meta.columns)}
+        batch = batch_from_pylist(
+            data, {c.name: c.type for c in meta.columns})
+        conn.replace(schema, table, batch)
+        n = sum(1 for row in res.rows if row[-1])
+        return _msg_result("UPDATE", n)
+
+    def _merge_stmt(self, stmt: "A.Merge") -> QueryResult:
+        """MERGE INTO target USING source ON cond WHEN ... — executed
+        as engine queries (reference: the MERGE row-change plan):
+        matched target rows flow through nested-CASE transforms (first
+        satisfied clause wins; DELETE arms drop the row), unmatched
+        source rows satisfying a NOT MATCHED arm are appended. A
+        target row matching multiple source rows is not detected (the
+        reference raises); the first join expansion wins."""
+        cat, schema, table = self._qualify(stmt.target)
+        self._check_access("update", cat, schema, table)
+        conn, meta = self._writable_meta(cat, schema, table, "MERGE")
+        talias = (stmt.target_alias or table).lower()
+        trel: A.Relation = A.Table((cat, schema, table))
+        if stmt.target_alias:
+            trel = A.AliasedRelation(trel, talias, ())
+
+        # source wrapped with a match indicator column
+        ind = "__merge_m"
+        src = stmt.source
+        src_alias = None
+        if isinstance(src, A.AliasedRelation):
+            src_alias = src.alias.lower()
+        elif isinstance(src, A.Table):
+            src_alias = src.parts[-1].lower()
+        else:
+            raise QueryError("MERGE source subquery requires an alias")
+        wrapped = A.AliasedRelation(
+            A.SubqueryRelation(A.Query(A.QuerySpecification(
+                (A.SelectItem(A.Star(), None),
+                 A.SelectItem(A.Literal(1), ind)),
+                from_=src))), src_alias, ())
+
+        matched_flag = A.IsNull(A.Identifier((src_alias, ind)),
+                                negated=True)
+
+        def arm_cond(cl: "A.MergeClause") -> A.Expression:
+            c: A.Expression = matched_flag if cl.matched else \
+                A.IsNull(A.Identifier((src_alias, ind)))
+            if cl.condition is not None:
+                c = A.BinaryOp("and", c, A.FunctionCall(
+                    "coalesce", (cl.condition, A.Literal(False))))
+            return c
+
+        matched_clauses = [c for c in stmt.clauses if c.matched]
+        insert_clauses = [c for c in stmt.clauses if not c.matched]
+        for cl in insert_clauses:
+            if cl.action != "insert":
+                raise QueryError(
+                    "WHEN NOT MATCHED supports only INSERT")
+        for cl in matched_clauses:
+            if cl.action not in ("update", "delete"):
+                raise QueryError(
+                    "WHEN MATCHED supports only UPDATE or DELETE")
+
+        # pass 1: target rows (kept/transformed)
+        items = []
+        for c in meta.columns:
+            whens = []
+            for cl in matched_clauses:
+                if cl.action != "update":
+                    continue
+                assigns = {k.lower(): v for k, v in cl.assignments}
+                if c.name in assigns:
+                    whens.append((arm_cond(cl),
+                                  A.Cast(assigns[c.name],
+                                         str(c.type))))
+                else:
+                    whens.append((arm_cond(cl),
+                                  A.Identifier((talias, c.name))))
+            items.append(A.SelectItem(
+                A.Case(tuple(whens), A.Identifier((talias, c.name)))
+                if whens else A.Identifier((talias, c.name)), c.name))
+        keep_whens = tuple(
+            (arm_cond(cl), A.Literal(cl.action != "delete"))
+            for cl in matched_clauses)
+        fired_whens = tuple((arm_cond(cl), A.Literal(True))
+                            for cl in matched_clauses)
+        items.append(A.SelectItem(
+            A.Case(keep_whens, A.Literal(True)), "__keep"))
+        items.append(A.SelectItem(
+            A.Case(fired_whens, A.Literal(False)), "__fired"))
+        res = self._run_query(A.QueryStatement(A.Query(
+            A.QuerySpecification(
+                tuple(items),
+                from_=A.Join("left", trel, wrapped, on=stmt.on)))))
+        kept = [row[:-2] for row in res.rows if row[-2]]
+        n_changed = sum(1 for row in res.rows if row[-1])
+
+        # pass 2: NOT MATCHED inserts
+        for cl in insert_clauses:
+            cols = tuple(c.lower() for c in cl.insert_columns) or \
+                tuple(c.name for c in meta.columns)
+            if len(cols) != len(cl.insert_values):
+                raise QueryError("MERGE INSERT arity mismatch")
+            by_col = dict(zip(cols, cl.insert_values))
+            ins_items = tuple(
+                A.SelectItem(A.Cast(by_col[c.name], str(c.type))
+                             if c.name in by_col else A.Literal(None),
+                             c.name)
+                for c in meta.columns)
+            where: A.Expression = A.Exists(A.Query(
+                A.QuerySpecification(
+                    (A.SelectItem(A.Literal(1), None),),
+                    from_=trel, where=stmt.on)), negated=True)
+            if cl.condition is not None:
+                where = A.BinaryOp("and", where, A.FunctionCall(
+                    "coalesce", (cl.condition, A.Literal(False))))
+            ires = self._run_query(A.QueryStatement(A.Query(
+                A.QuerySpecification(ins_items, from_=src,
+                                     where=where))))
+            kept.extend(ires.rows)
+            n_changed += len(ires.rows)
+
+        data = {c.name: [row[i] for row in kept]
+                for i, c in enumerate(meta.columns)}
+        batch = batch_from_pylist(
+            data, {c.name: c.type for c in meta.columns})
+        conn.replace(schema, table, batch)
+        return _msg_result("MERGE", n_changed)
 
     def _check_access(self, privilege: str, cat: str, schema: str,
                       table: str) -> None:

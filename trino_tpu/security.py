@@ -98,6 +98,11 @@ class AccessControl:
                          table: str) -> None:
         pass
 
+    def check_can_update(self, user: str, catalog: str, schema: str,
+                         table: str) -> None:
+        # UPDATE/MERGE require the same write privilege as DELETE
+        self.check_can_delete(user, catalog, schema, table)
+
     def check_can_delete(self, user: str, catalog: str, schema: str,
                          table: str) -> None:
         pass

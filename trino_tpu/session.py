@@ -30,6 +30,9 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     "task_concurrency": (int, 1),                    # :61
     "spill_enabled": (bool, CONFIG.spill_enabled),   # :91
     "enable_dynamic_filtering": (bool, True),        # :123
+    # range-exchange distributed ORDER BY (exec/distributed.py
+    # _dexec_SortNode); reference SystemSessionProperties :106
+    "distributed_sort": (bool, True),
     "query_max_memory_per_node": (int, CONFIG.max_query_memory_per_node),
     # connector pushdown (PushPredicateIntoTableScan /
     # PushLimitIntoTableScan); consulted by planner/optimizer.py

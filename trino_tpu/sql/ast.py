@@ -427,6 +427,33 @@ class Delete(Statement):
 
 
 @dataclass(frozen=True)
+class Update(Statement):
+    table: Tuple[str, ...] = ()
+    assignments: Tuple[Tuple[str, Expression], ...] = ()
+    where: Optional[Expression] = None
+
+
+@dataclass(frozen=True)
+class MergeClause(Node):
+    """One WHEN [NOT] MATCHED [AND cond] THEN action arm."""
+    matched: bool
+    condition: Optional[Expression]
+    action: str                                  # update | delete | insert
+    assignments: Tuple[Tuple[str, Expression], ...] = ()
+    insert_columns: Tuple[str, ...] = ()
+    insert_values: Tuple[Expression, ...] = ()
+
+
+@dataclass(frozen=True)
+class Merge(Statement):
+    target: Tuple[str, ...] = ()
+    target_alias: Optional[str] = None
+    source: Relation = None  # type: ignore
+    on: Expression = None    # type: ignore
+    clauses: Tuple[MergeClause, ...] = ()
+
+
+@dataclass(frozen=True)
 class UseStatement(Statement):
     catalog: Optional[str] = None
     schema: str = ""
@@ -466,6 +493,11 @@ class ExecuteStmt(Statement):
 @dataclass(frozen=True)
 class Deallocate(Statement):
     name: str = ""
+
+
+@dataclass(frozen=True)
+class ShowStats(Statement):
+    table: Tuple[str, ...]
 
 
 @dataclass(frozen=True)

@@ -266,7 +266,86 @@ class _Parser:
             table = self.qualified_name()
             where = self.expression() if self.accept_kw("where") else None
             return A.Delete(table, where)
+        if self.at_kw("update"):
+            self.next()
+            table = self.qualified_name()
+            self.expect_kw("set")
+            assigns = [self._assignment()]
+            while self.accept_op(","):
+                assigns.append(self._assignment())
+            where = self.expression() if self.accept_kw("where") else None
+            return A.Update(table, tuple(assigns), where)
+        if self.at_kw("merge"):
+            return self._merge()
         return A.QueryStatement(self.query())
+
+    def _assignment(self):
+        name = self.identifier()
+        self.expect_op("=")
+        return (name, self.expression())
+
+    def _merge(self) -> "A.Merge":
+        self.expect_kw("merge")
+        self.expect_kw("into")
+        target = self.qualified_name()
+        alias = None
+        if self.accept_kw("as"):
+            alias = self.identifier()
+        elif not self.at_kw("using"):
+            alias = self.identifier()
+        self.expect_kw("using")
+        source = self._table_or_subquery()
+        self.expect_kw("on")
+        on = self.expression()
+        clauses = []
+        while self.at_kw("when"):
+            self.next()
+            matched = not self.accept_kw("not")
+            self.expect_kw("matched")
+            cond = self.expression() if self.accept_kw("and") else None
+            self.expect_kw("then")
+            if self.accept_kw("update"):
+                self.expect_kw("set")
+                assigns = [self._assignment()]
+                while self.accept_op(","):
+                    assigns.append(self._assignment())
+                clauses.append(A.MergeClause(matched, cond, "update",
+                                             tuple(assigns)))
+            elif self.accept_kw("delete"):
+                clauses.append(A.MergeClause(matched, cond, "delete"))
+            else:
+                self.expect_kw("insert")
+                cols: List[str] = []
+                if self.at_op("("):
+                    self.expect_op("(")
+                    cols.append(self.identifier())
+                    while self.accept_op(","):
+                        cols.append(self.identifier())
+                    self.expect_op(")")
+                self.expect_kw("values")
+                self.expect_op("(")
+                vals = [self.expression()]
+                while self.accept_op(","):
+                    vals.append(self.expression())
+                self.expect_op(")")
+                clauses.append(A.MergeClause(
+                    matched, cond, "insert", (), tuple(cols),
+                    tuple(vals)))
+        if not clauses:
+            t = self.peek()
+            raise ParseError("MERGE requires at least one WHEN clause",
+                             t.line, t.column)
+        return A.Merge(target, alias, source, on, tuple(clauses))
+
+    def _table_or_subquery(self) -> "A.Relation":
+        if self.at_op("("):
+            self.expect_op("(")
+            q = self.query()
+            self.expect_op(")")
+            rel: A.Relation = A.SubqueryRelation(q)
+        else:
+            rel = A.Table(self.qualified_name())
+        return self._maybe_alias(rel)
 
     def _looks_like_column_list(self) -> bool:
         # distinguish INSERT INTO t (a, b) SELECT  from  INSERT INTO t (SELECT ...)
@@ -301,6 +380,9 @@ class _Parser:
             if kind == "table":
                 self.expect_kw("table")
             return A.ShowCreate(kind, self.qualified_name())
+        if self.accept_kw("stats"):
+            self.expect_kw("for")
+            return A.ShowStats(self.qualified_name())
         t = self.peek()
         raise ParseError(f"unsupported SHOW {t.value!r}", t.line, t.column)
 
@@ -809,10 +891,21 @@ class _Parser:
 
     def _additive(self) -> A.Expression:
         left = self._multiplicative()
-        while self.at_op("+", "-"):
-            op = self.next().value
-            left = A.BinaryOp(op, left, self._multiplicative())
-        return left
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                left = A.BinaryOp(op, left, self._multiplicative())
+                continue
+            # expr AT TIME ZONE 'zone' (reference: AtTimeZone desugar)
+            if (self.at_kw("at") and self.at_kw("time", ahead=1)
+                    and self.at_kw("zone", ahead=2)):
+                self.next()
+                self.next()
+                self.next()
+                left = A.FunctionCall("at_timezone",
+                                      (left, self._multiplicative()))
+                continue
+            return left
 
     def _multiplicative(self) -> A.Expression:
         left = self._unary()
@@ -1161,6 +1254,12 @@ class _Parser:
             while self.accept_op(","):
                 params.append(self.next().value)
             self.expect_op(")")
-        if params:
-            return f"{base}({','.join(params)})"
-        return base
+        name = f"{base}({','.join(params)})" if params else base
+        if base in ("timestamp", "time") and self.at_kw("with", "without"):
+            without = self.at_kw("without")
+            self.next()
+            self.expect_kw("time")
+            self.expect_kw("zone")
+            if not without:
+                name += " with time zone"
+        return name

@@ -171,6 +171,75 @@ def iso_time_millis(s: str) -> int:
 
 
 @dataclass(frozen=True)
+class TimestampTZType(Type):
+    """TIMESTAMP(p) WITH TIME ZONE (spi/type/
+    TimestampWithTimeZoneType.java packs millis+zoneKey in one long).
+    TPU-first layout: the ``data`` lane is the UTC instant in epoch
+    milliseconds — so comparison/ordering/grouping/joins are plain
+    int64 lane ops with the correct instant semantics — and the
+    ``data2`` lane carries the per-value zone offset in MINUTES, used
+    only for display and field extraction (it does NOT participate in
+    equality, matching the reference's instant-based equality)."""
+    precision: int = 3
+
+    def __init__(self, precision: int = 3):
+        object.__setattr__(self, "name",
+                           f"timestamp({precision}) with time zone")
+        object.__setattr__(self, "precision", precision)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)
+
+
+def zone_offset_minutes(zone: str, instant_ms=None) -> int:
+    """Fixed-offset zone string ('+05:30', '-08:00', 'UTC', or an IANA
+    name resolved at ``instant_ms``) -> offset minutes."""
+    z = zone.strip()
+    if z.upper() in ("UTC", "Z"):
+        return 0
+    if z and z[0] in "+-":
+        sign = -1 if z[0] == "-" else 1
+        hh, _, mm = z[1:].partition(":")
+        return sign * (int(hh) * 60 + int(mm or 0))
+    import datetime
+    from zoneinfo import ZoneInfo
+    dt = (datetime.datetime(1970, 1, 1, tzinfo=datetime.timezone.utc)
+          + datetime.timedelta(milliseconds=int(instant_ms or 0)))
+    off = dt.astimezone(ZoneInfo(z)).utcoffset()
+    return int(off.total_seconds() // 60)
+
+
+def iso_timestamp_tz(s: str):
+    """Timestamp text with zone -> (utc_millis, offset_minutes).
+    Accepts '2020-01-01 00:00:00 +05:30', '...Z', '... UTC', and
+    '... Region/City' forms; None offset part -> (naive, None)."""
+    import datetime
+    import re as _re
+    text = s.strip()
+    m = _re.match(
+        r"^(\d{4}-\d{2}-\d{2}[ T]\d{2}:\d{2}(?::\d{2}(?:\.\d+)?)?)"
+        r"\s*(Z|UTC|[+-]\d{2}(?::?\d{2})?|[A-Za-z_]+/[A-Za-z_]+)?$",
+        text)
+    if not m:
+        raise ValueError(f"cannot parse timestamp: {s!r}")
+    base, zone = m.group(1), m.group(2)
+    naive = datetime.datetime.fromisoformat(base.replace("T", " "))
+    local_ms = int((naive - datetime.datetime(1970, 1, 1))
+                   .total_seconds() * 1000)
+    if zone is None:
+        return local_ms, None
+    if "/" in zone:
+        from zoneinfo import ZoneInfo
+        aware = naive.replace(tzinfo=ZoneInfo(zone))
+        off = aware.utcoffset()
+        offset_min = int(off.total_seconds() // 60)
+    else:
+        offset_min = zone_offset_minutes(zone)
+    return local_ms - offset_min * 60000, offset_min
+
+
+@dataclass(frozen=True)
 class TimeType(Type):
     """TIME(p): milliseconds of day in an int64 lane
     (spi/type/TimeType.java)."""
@@ -373,6 +442,15 @@ def parse_type(s: str) -> Type:
             else:
                 fields.append((None, parse_type(part)))
         return RowType(fields)
+    low2 = " ".join(low.split())
+    if low2.endswith(" with time zone"):
+        mtz = _TYPE_RE.match(low2[:-len(" with time zone")])
+        if mtz and mtz.group(1) == "timestamp":
+            return TimestampTZType(int(mtz.group(2))
+                                   if mtz.group(2) else 3)
+        raise ValueError(f"unknown type: {s!r}")
+    if low2.endswith(" without time zone"):
+        return parse_type(low2[:-len(" without time zone")])
     m = _TYPE_RE.match(s.lower())
     if not m:
         raise ValueError(f"cannot parse type: {s!r}")
