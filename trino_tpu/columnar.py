@@ -365,6 +365,16 @@ class Batch:
         return Batch({k: c.gather(indices)
                       for k, c in self.columns.items()}, num_rows)
 
+    def _host_fetched(self) -> "Batch":
+        leaves = jax.device_get(
+            {k: [c.data, c.valid, c.data2]
+             for k, c in self.columns.items()})
+        cols = {}
+        for k, c in self.columns.items():
+            d, v, d2 = leaves[k]
+            cols[k] = replace(c, data=d, valid=v, data2=d2)
+        return Batch(cols, self.num_rows)
+
     # --- host materialization (result delivery / tests) ------------------
     def to_pylist(self) -> List[list]:
         """Rows as python lists (client result encoding, reference:
