@@ -1,0 +1,122 @@
+"""HyperLogLog sketch family: approx_set / merge / cardinality /
+empty_approx_set / casts (reference: operator/aggregation/
+ApproximateSetAggregation.java, MergeHyperLogLogAggregation.java,
+operator/scalar/HyperLogLogFunctions.java; sketch design in
+trino_tpu/ops/hll.py)."""
+
+import numpy as np
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(session=Session(catalog="tpch",
+                                            schema="tiny"))
+
+
+def test_approx_set_small_exact(runner):
+    rows = runner.execute(
+        "SELECT cardinality(approx_set(x)) "
+        "FROM (VALUES 1,2,3,4,5,2,3,NULL) t(x)").rows
+    assert rows == [[5]]
+
+
+def test_approx_set_grouped(runner):
+    rows = runner.execute(
+        "SELECT k, cardinality(approx_set(v)) FROM (VALUES "
+        "('a',1),('a',2),('b',3),('b',3),('a',2)) t(k,v) "
+        "GROUP BY k ORDER BY k").rows
+    assert rows == [["a", 2], ["b", 1]]
+
+
+def test_approx_set_null_only_group_is_null(runner):
+    rows = runner.execute(
+        "SELECT k, approx_set(v) IS NULL FROM (VALUES "
+        "('a', 1), ('b', CAST(NULL AS integer))) t(k,v) "
+        "GROUP BY k ORDER BY k").rows
+    assert rows == [["a", False], ["b", True]]
+
+
+def test_approx_set_accuracy_sf_column(runner):
+    [[approx]] = runner.execute(
+        "SELECT cardinality(approx_set(l_orderkey)) FROM lineitem").rows
+    [[exact]] = runner.execute(
+        "SELECT count(DISTINCT l_orderkey) FROM lineitem").rows
+    # m=2048 -> stderr ~2.3%; allow 4 sigma
+    assert abs(approx - exact) / exact < 0.10
+
+
+def test_approx_set_error_parameter(runner):
+    [[approx]] = runner.execute(
+        "SELECT cardinality(approx_set(l_orderkey, 0.01)) "
+        "FROM lineitem").rows
+    [[exact]] = runner.execute(
+        "SELECT count(DISTINCT l_orderkey) FROM lineitem").rows
+    assert abs(approx - exact) / exact < 0.045
+
+    with pytest.raises(Exception):
+        runner.execute("SELECT approx_set(l_orderkey, 0.5) "
+                       "FROM lineitem")
+
+
+def test_merge_matches_global(runner):
+    # merging per-group sketches must give the global sketch exactly
+    # (register max is associative)
+    [[merged]] = runner.execute(
+        "SELECT cardinality(merge(s)) FROM (SELECT l_returnflag k, "
+        "approx_set(l_partkey) s FROM lineitem GROUP BY "
+        "l_returnflag)").rows
+    [[direct]] = runner.execute(
+        "SELECT cardinality(approx_set(l_partkey)) FROM lineitem").rows
+    assert merged == direct
+
+
+def test_merge_grouped(runner):
+    rows = runner.execute(
+        "SELECT g, cardinality(merge(s)) FROM (SELECT k, k = 'c' g, "
+        "approx_set(v) s FROM (VALUES ('a',1),('a',2),('b',2),('b',3),"
+        "('c',9)) t(k,v) GROUP BY k) GROUP BY g ORDER BY g").rows
+    assert rows == [[False, 3], [True, 1]]
+
+
+def test_empty_approx_set(runner):
+    assert runner.execute(
+        "SELECT cardinality(empty_approx_set())").rows == [[0]]
+
+
+def test_cast_roundtrip(runner):
+    rows = runner.execute(
+        "SELECT cardinality(CAST(CAST(approx_set(x) AS varbinary) "
+        "AS hyperloglog)) FROM (VALUES 1,2,3,4) t(x)").rows
+    assert rows == [[4]]
+
+
+def test_try_cast_malformed_sketch_is_null(runner):
+    rows = runner.execute(
+        "SELECT TRY_CAST('garbage' AS hyperloglog) IS NULL").rows
+    assert rows == [[True]]
+    with pytest.raises(Exception):
+        runner.execute("SELECT CAST('garbage' AS hyperloglog)")
+
+
+def test_merge_rejects_non_sketch(runner):
+    with pytest.raises(Exception):
+        runner.execute("SELECT merge(x) FROM (VALUES 1,2) t(x)")
+
+
+def test_approx_distinct_strings(runner):
+    [[approx]] = runner.execute(
+        "SELECT approx_distinct(l_shipmode) FROM lineitem").rows
+    assert approx == 7
+
+
+def test_hll_distributed_matches_local(runner):
+    sql = ("SELECT l_returnflag, cardinality(approx_set(l_partkey)) "
+           "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag")
+    dist = LocalQueryRunner(distributed=True, n_devices=8,
+                            session=Session(catalog="tpch",
+                                            schema="tiny"))
+    assert dist.execute(sql).rows == runner.execute(sql).rows

@@ -418,6 +418,15 @@ class Batch:
                         # exact strings, FixJsonDataUtils.java)
                         col.append(q if not s
                                    else _dec.Decimal(q).scaleb(-s))
+            elif t.name == "hyperloglog":
+                # rendered like the client renders varbinary: base64 of
+                # this engine's dense sketch framing (ops/hll.py)
+                from .ops.hll import sketches_to_base64
+                enc = sketches_to_base64(data[:n],
+                                         np.asarray(c.data2)[:n],
+                                         np.asarray(c.elements.data),
+                                         t.bucket_bits)
+                col = [(enc[i] if valid[i] else None) for i in range(n)]
             elif t.name.startswith("array("):
                 # materialize the flat elements once, slice per row
                 e = c.elements

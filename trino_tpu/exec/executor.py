@@ -172,7 +172,8 @@ class Executor:
 
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
                       "approx_percentile", "array_agg", "map_agg",
-                      "histogram", "approx_most_frequent"}
+                      "histogram", "approx_most_frequent",
+                      "approx_set", "merge"}
 
     def _try_streaming_aggregation(self, node: AggregationNode):
         # kinds whose partials don't combine with a single-lane segment
@@ -1144,6 +1145,17 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
         elif kind == "approx_distinct":
             phys.append(AggInput("count_distinct", a.argument, a.mask,
                                  sym))
+        elif kind == "approx_set":
+            # param (if present) is the requested max standard error;
+            # translate to a bucket-count exponent once at plan time
+            from ..ops.hll import (APPROX_SET_BUCKET_BITS,
+                                   bucket_bits_for_error)
+            b = (bucket_bits_for_error(float(a.param))
+                 if a.param is not None else APPROX_SET_BUCKET_BITS)
+            phys.append(AggInput("hll", a.argument, a.mask, sym,
+                                 param=float(b)))
+        elif kind == "merge":
+            phys.append(AggInput("hll_merge", a.argument, a.mask, sym))
         elif kind == "array_agg":
             phys.append(AggInput("array_agg", a.argument, a.mask, sym))
         elif kind == "map_agg":

@@ -261,6 +261,61 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
             src, type=t,
             elements=cast_column(src.elements, t.key, safe),
             elements2=cast_column(src.elements2, t.value, safe))
+    from ..types import HyperLogLogType, VARBINARY as _VB
+
+    def _stringy(x):
+        return is_string(x) or x is _VB or x.name == "varbinary"
+    if isinstance(s, HyperLogLogType) and _stringy(t):
+        # cast(hll as varbinary/varchar): base64 of this engine's dense
+        # framing (ops/hll.py — shared with client result encoding)
+        from ..ops.hll import sketches_to_base64
+        out = sketches_to_base64(jax.device_get(src.data),
+                                 jax.device_get(src.data2),
+                                 np.asarray(
+                                     jax.device_get(src.elements.data)),
+                                 s.bucket_bits)
+        dct, codes = StringDictionary.from_strings(out)
+        return Column(t, jnp.asarray(codes), src.valid, dct)
+    if isinstance(t, HyperLogLogType) and _stringy(s):
+        import base64 as _b64
+        from ..ops.hll import deserialize_registers, entries_from_dense
+        from ..types import INTEGER as _INT
+        pool, pool_b, bad = [], [], np.zeros(
+            len(src.dictionary.values), bool)
+        for i, v in enumerate(src.dictionary.values):
+            try:
+                regs = deserialize_registers(_b64.b64decode(v))
+                pool.append(entries_from_dense(regs))
+                pool_b.append(int(regs.shape[0]).bit_length() - 1)
+            except Exception as ex:
+                if not safe:
+                    raise EvalError(
+                        f"cannot cast to hyperloglog: {ex}")
+                pool.append(np.zeros((0,), np.int32))
+                pool_b.append(-1)
+                bad[i] = True
+        real_b = sorted({b for b in pool_b if b >= 0})
+        if len(real_b) > 1:
+            raise EvalError(
+                "cannot cast a column mixing HyperLogLog precisions "
+                f"(bucket bits {real_b})")
+        bbits = real_b[0] if real_b else t.bucket_bits
+        lens = np.asarray([p.shape[0] for p in pool], np.int64)
+        offs = np.cumsum(lens) - lens
+        flat = (np.concatenate(pool) if pool
+                else np.zeros((0,), np.int32))
+        from ..config import capacity_for as _cfor
+        pad = _cfor(max(int(flat.shape[0]), 1))
+        flat = np.pad(flat, (0, pad - flat.shape[0]))
+        codes = jnp.asarray(src.data).astype(jnp.int64)
+        starts = jnp.take(jnp.asarray(offs), codes, mode="clip")
+        lns = jnp.take(jnp.asarray(lens), codes, mode="clip")
+        valid = src.valid
+        if bad.any():
+            ok = jnp.take(jnp.asarray(~bad), codes, mode="clip")
+            valid = ok if valid is None else jnp.asarray(valid) & ok
+        return Column(HyperLogLogType(bbits), starts, valid, None,
+                      lns, Column(_INT, jnp.asarray(flat)))
     # string source -> parse host-side over dictionary
     if is_string(s) and not is_string(t):
         return _dict_transform(src, _parser_for(t, safe), t)
@@ -1352,10 +1407,30 @@ def _array_ctor(e, batch):
 
 def _cardinality(e, batch):
     a = eval_expr(e.args[0], batch)
+    from ..types import HyperLogLogType
+    if isinstance(a.type, HyperLogLogType):
+        # cardinality(hll): the HLL estimator over each row's sparse
+        # entries (reference: operator/scalar/HyperLogLogFunctions.java)
+        from ..ops.hll import estimate_from_sparse
+        est = estimate_from_sparse(jnp.asarray(a.data),
+                                   jnp.asarray(a.data2),
+                                   jnp.asarray(a.elements.data),
+                                   a.type.bucket_bits)
+        return Column(BIGINT, est, a.valid)
     if a.elements is None:
         raise EvalError("cardinality requires an array or map")
     return Column(BIGINT, jnp.asarray(a.data2).astype(jnp.int64),
                   a.valid)
+
+
+def _empty_approx_set(e, batch):
+    """Constant empty HLL sketch per row (HyperLogLogFunctions.java):
+    zero sparse entries."""
+    from ..types import HYPER_LOG_LOG, INTEGER
+    cap = batch.capacity
+    empty = Column(INTEGER, jnp.zeros((8,), jnp.int32))
+    return Column(HYPER_LOG_LOG, jnp.zeros((cap,), jnp.int64), None,
+                  None, jnp.zeros((cap,), jnp.int64), empty)
 
 
 def _element_at(e, batch):
@@ -1445,6 +1520,7 @@ _DISPATCH: Dict[str, Callable] = {
     "date_trunc": _date_trunc, "date_diff": _date_diff,
     "date_add": _date_add,
     "$array": _array_ctor, "cardinality": _cardinality,
+    "empty_approx_set": _empty_approx_set,
     "element_at": _element_at,
     "from_unixtime": _from_unixtime, "to_unixtime": _to_unixtime,
     "date_format": _date_format, "date_parse": _date_parse,

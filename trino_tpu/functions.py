@@ -25,7 +25,7 @@ AGGREGATE_NAMES = {
     "approx_distinct", "min_by", "max_by", "array_agg", "checksum",
     "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
     "skewness", "kurtosis", "approx_percentile", "map_agg", "histogram",
-    "approx_most_frequent",
+    "approx_most_frequent", "approx_set", "merge",
 }
 
 WINDOW_ONLY_NAMES = {
@@ -66,6 +66,18 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
         return DOUBLE
     if name == "checksum":
         return BIGINT
+    if name == "approx_set":
+        from .types import HYPER_LOG_LOG
+        return HYPER_LOG_LOG
+    if name == "merge":
+        # merge() combines sketch values (HLL today; reference also
+        # accepts qdigest/tdigest) — result type follows the input
+        from .types import HyperLogLogType
+        if not isinstance(t, HyperLogLogType):
+            raise FunctionResolutionError(
+                f"merge({t}) not supported: argument must be a "
+                "HyperLogLog sketch")
+        return t
     if name == "array_agg":
         from .types import ArrayType
         return ArrayType(t)
@@ -258,12 +270,19 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "map_values": lambda n, a: _mk_array(_map_of(n, a).value),
     "map_concat": _common,
     "map_entries": lambda n, a: _map_entries(n, a),
+    # HyperLogLog (operator/scalar/HyperLogLogFunctions.java)
+    "empty_approx_set": lambda n, a: _hll_type(),
     # JSON (operator/scalar/JsonFunctions.java)
     "json_extract_scalar": _varchar_fn,
     "json_extract": _varchar_fn,
     "json_array_length": _bigint_fn,
     "json_size": _bigint_fn,
 }
+
+
+def _hll_type():
+    from .types import HYPER_LOG_LOG
+    return HYPER_LOG_LOG
 
 
 def _array_elem(name, args):

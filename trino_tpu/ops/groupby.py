@@ -531,6 +531,43 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         ov = gv if out.valid is None else gv & jnp.asarray(out.valid)
         return _replace(out, valid=ov)
 
+    if agg.kind == "hll":
+        # approx_set: per-group sparse HLL entries, one extra sort +
+        # segment pass (reference: ApproximateSetAggregation; design
+        # note in ops/hll.py)
+        from ..types import HyperLogLogType, INTEGER as _INT
+        from .hll import DEFAULT_BUCKET_BITS, grouped_sparse_hll
+        b = int(agg.param) if agg.param else DEFAULT_BUCKET_BITS
+        start, length, entries = grouped_sparse_hll(vals, valid, gid,
+                                                    gcap, b)
+        return Column(HyperLogLogType(b), start, group_valid, None,
+                      length, Column(_INT, entries))
+
+    if agg.kind == "hll_merge":
+        # merge(hll): per-group max-union of sketch rows. Host numpy —
+        # merge consumes small pre-aggregated sketch batches, and the
+        # chain-JIT falls back to eager execution on the host round
+        # trip (reference: MergeHyperLogLogAggregation)
+        from ..types import HyperLogLogType, INTEGER as _INT
+        from .hll import merge_sparse_host
+        b = getattr(col.type, "bucket_bits", 11)
+        import numpy as _onp
+        starts = _onp.asarray(jax.device_get(vals))
+        lens = _onp.asarray(jax.device_get(
+            jnp.take(jnp.asarray(col.data2), order)))
+        ent = _onp.asarray(jax.device_get(col.elements.data))
+        v_np = _onp.asarray(jax.device_get(valid))
+        g_np = _onp.asarray(jax.device_get(gid))
+        start, length, out_ent = merge_sparse_host(
+            starts, lens, ent, v_np, g_np, gcap, b)
+        cap_e = max(int(out_ent.shape[0]), 1)
+        from ..config import capacity_for as _cfor
+        pad = _cfor(cap_e)
+        out_ent = _onp.pad(out_ent, (0, pad - out_ent.shape[0]))
+        return Column(HyperLogLogType(b), jnp.asarray(start),
+                      group_valid, None, jnp.asarray(length),
+                      Column(_INT, jnp.asarray(out_ent)))
+
     if agg.kind in ("count_distinct", "percentile"):
         return _resorted_agg(batch, agg, col, gid, live_s, gcap,
                              key_lanes, extra_mask, order, live_u)
@@ -878,6 +915,34 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             out[agg.output] = Column(
                 out_t, jnp.zeros((1,), jnp.int64), (nent > 0)[None],
                 None, nent[None], keys_pool, vals_pool)
+        elif agg.kind == "hll":
+            from ..types import HyperLogLogType, INTEGER as _INT
+            from .hll import DEFAULT_BUCKET_BITS, grouped_sparse_hll
+            b = int(agg.param) if agg.param else DEFAULT_BUCKET_BITS
+            gid0 = jnp.zeros((batch.capacity,), jnp.int32)
+            start, length, entries = grouped_sparse_hll(vals, valid,
+                                                        gid0, 1, b)
+            out[agg.output] = Column(
+                HyperLogLogType(b), start, has, None, length,
+                Column(_INT, entries))
+        elif agg.kind == "hll_merge":
+            from ..types import HyperLogLogType, INTEGER as _INT
+            from .hll import merge_sparse_host
+            from ..config import capacity_for as _cfor
+            b = getattr(col.type, "bucket_bits", 11)
+            import numpy as _onp
+            starts = _onp.asarray(jax.device_get(vals))
+            lens = _onp.asarray(jax.device_get(col.data2))
+            ent = _onp.asarray(jax.device_get(col.elements.data))
+            v_np = _onp.asarray(jax.device_get(valid))
+            g_np = _onp.zeros(batch.capacity, _onp.int64)
+            start, length, out_ent = merge_sparse_host(
+                starts, lens, ent, v_np, g_np, 1, b)
+            pad = _cfor(max(int(out_ent.shape[0]), 1))
+            out_ent = _onp.pad(out_ent, (0, pad - out_ent.shape[0]))
+            out[agg.output] = Column(
+                HyperLogLogType(b), jnp.asarray(start), has, None,
+                jnp.asarray(length), Column(_INT, jnp.asarray(out_ent)))
         elif agg.kind == "percentile":
             from dataclasses import replace as _replace
             if col.data2 is not None:

@@ -505,6 +505,21 @@ class LogicalPlanner:
                                 "approx_percentile: percentage must be "
                                 "a constant")
                         param = float(a1.value)
+                    elif kind in ("approx_set", "approx_distinct"):
+                        a1 = args[1]
+                        if not isinstance(a1, Const) or a1.value is None:
+                            raise PlanningError(
+                                f"{kind}: max standard error must be a "
+                                "constant")
+                        param = float(a1.value)
+                        if kind == "approx_set":
+                            # validate eagerly (plan-time error beats a
+                            # kernel-trace error)
+                            from ..ops.hll import bucket_bits_for_error
+                            try:
+                                bucket_bits_for_error(param)
+                            except ValueError as ex:
+                                raise PlanningError(str(ex))
                     elif kind in ("min_by", "max_by", "corr",
                                   "covar_samp", "covar_pop",
                                   "regr_slope", "regr_intercept",

@@ -29,7 +29,7 @@ __all__ = [
     "Type", "BOOLEAN", "TINYINT", "SMALLINT", "INTEGER", "BIGINT", "REAL",
     "DOUBLE", "VARCHAR", "VARBINARY", "DATE", "UNKNOWN", "DecimalType",
     "VarcharType", "CharType", "TimestampType", "TimeType", "ArrayType",
-    "MapType", "RowType",
+    "MapType", "RowType", "HyperLogLogType", "HYPER_LOG_LOG",
     "IntervalDayTime", "IntervalYearMonth", "parse_type", "common_super_type",
     "is_numeric", "is_integral", "is_exact_numeric", "is_string",
 ]
@@ -75,6 +75,32 @@ _PHYSICAL = {
     "interval year to month": np.dtype(np.int32),  # months
     "unknown": np.dtype(np.bool_),
 }
+
+
+@dataclass(frozen=True)
+class HyperLogLogType(Type):
+    """HLL sketch (reference: spi/type/HyperLogLogType + airlift-stats).
+
+    Physically an ARRAY-like column: offsets into a flat register lane
+    (``ops/hll.py``). ``bucket_bits`` is static per column so kernels see
+    a fixed register width."""
+
+    bucket_bits: int = 11
+
+    def __init__(self, bucket_bits: int = 11):
+        object.__setattr__(self, "name", "hyperloglog")
+        object.__setattr__(self, "bucket_bits", bucket_bits)
+
+    @property
+    def num_buckets(self) -> int:
+        return 1 << self.bucket_bits
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)  # offset lane
+
+
+HYPER_LOG_LOG = HyperLogLogType()
 
 
 @dataclass(frozen=True)
@@ -417,6 +443,8 @@ _SIMPLE["int"] = INTEGER
 _SIMPLE["string"] = VARCHAR
 _SIMPLE["varchar"] = VARCHAR
 _SIMPLE["timestamp"] = TimestampType(3)
+_SIMPLE["hyperloglog"] = HYPER_LOG_LOG
+_SIMPLE["p4hyperloglog"] = HYPER_LOG_LOG
 
 
 def parse_type(s: str) -> Type:
