@@ -236,3 +236,158 @@ def test_mixed_same_arg_distinct(runner):
     got = q(runner, "SELECT sum(DISTINCT x), count(DISTINCT x), "
                     "avg(DISTINCT x) FROM (VALUES 1, 2, 2, 3) t(x)")
     assert got == [[6, 3, 2.0]]
+
+
+# -- bitwise / collection aggregates (round 4) ------------------------------
+
+def test_bitwise_aggs_global(runner):
+    got = q(runner, "SELECT bitwise_and_agg(x), bitwise_or_agg(x) "
+                    "FROM (VALUES 12, 10, NULL, 14) t(x)")
+    assert got == [[12 & 10 & 14, 12 | 10 | 14]]
+
+
+def test_bitwise_aggs_empty_and_null(runner):
+    got = q(runner, "SELECT bitwise_and_agg(x), bitwise_or_agg(x) "
+                    "FROM (VALUES CAST(NULL AS BIGINT)) t(x)")
+    assert got == [[None, None]]
+
+
+def test_bitwise_aggs_grouped(runner):
+    got = q(runner, "SELECT g, bitwise_and_agg(x), bitwise_or_agg(x) "
+                    "FROM (VALUES (1, 7), (1, 5), (2, 8), (2, 2), "
+                    "(2, NULL)) t(g, x) GROUP BY g ORDER BY g")
+    assert got == [[1, 7 & 5, 7 | 5], [2, 8 & 2, 8 | 2]]
+
+
+def test_bitwise_aggs_grouped_general_path(runner, li):
+    # keys with a large domain force the lexsort+segmented-scan kernel
+    _, con = li
+    rows = con.execute("SELECT pk, CAST(qty AS INT) FROM t").fetchall()
+    import collections
+    a = collections.defaultdict(lambda: -1)
+    o = collections.defaultdict(int)
+    for pk, x in rows:
+        a[pk] &= x
+        o[pk] |= x
+    exp = sorted([k, a[k], o[k]] for k in a)[:20]
+    got = q(runner, "SELECT l_partkey, "
+                    "bitwise_and_agg(CAST(l_quantity AS INTEGER)), "
+                    "bitwise_or_agg(CAST(l_quantity AS INTEGER)) "
+                    "FROM tpch.tiny.lineitem GROUP BY l_partkey "
+                    "ORDER BY l_partkey LIMIT 20")
+    assert got == exp
+
+
+def test_map_union_global(runner):
+    got = q(runner, "SELECT map_union(m) FROM (VALUES "
+                    "map(ARRAY[1, 2], ARRAY[10, 20]), "
+                    "map(ARRAY[2, 3], ARRAY[99, 30])) t(m)")
+    assert got == [[{1: 10, 2: 20, 3: 30}]]
+
+
+def test_map_union_grouped(runner):
+    got = q(runner, "SELECT g, map_union(m) FROM (VALUES "
+                    "(1, map(ARRAY['a'], ARRAY[1])), "
+                    "(1, map(ARRAY['b'], ARRAY[2])), "
+                    "(2, map(ARRAY['c'], ARRAY[3])), "
+                    "(2, CAST(NULL AS map(varchar, integer)))"
+                    ") t(g, m) GROUP BY g ORDER BY g")
+    assert got == [[1, {"a": 1, "b": 2}], [2, {"c": 3}]]
+
+
+def test_multimap_agg(runner):
+    got = q(runner, "SELECT multimap_agg(k, v) FROM (VALUES "
+                    "('a', 1), ('b', 2), ('a', 3)) t(k, v)")
+    assert got == [[{"a": [1, 3], "b": [2]}]]
+
+
+def test_multimap_agg_grouped(runner):
+    got = q(runner, "SELECT g, multimap_agg(k, v) FROM (VALUES "
+                    "(1, 'x', 1), (1, 'x', 2), (2, 'y', 3)) t(g, k, v) "
+                    "GROUP BY g ORDER BY g")
+    assert got == [[1, {"x": [1, 2]}], [2, {"y": [3]}]]
+
+
+def test_numeric_histogram(runner):
+    got = q(runner, "SELECT numeric_histogram(4, x) FROM (VALUES "
+                    "1.0, 1.0, 2.0, 50.0, 51.0, 100.0) t(x)")
+    (m,), = got
+    assert sum(m.values()) == 6.0
+    assert len(m) == 4
+    assert min(m) >= 1.0 and max(m) <= 100.0
+
+
+def test_numeric_histogram_merges_closest(runner):
+    got = q(runner, "SELECT numeric_histogram(2, x) FROM (VALUES "
+                    "1.0, 2.0, 100.0) t(x)")
+    (m,), = got
+    assert m == {1.5: 2.0, 100.0: 1.0}
+
+
+def test_tdigest_agg(runner):
+    got = q(runner, "SELECT value_at_quantile(tdigest_agg(x), 0.5e0), "
+                    "value_at_quantile(tdigest_agg(x), 0.0e0), "
+                    "value_at_quantile(tdigest_agg(x), 1.0e0) "
+                    "FROM (VALUES 1.0e0, 2.0e0, 3.0e0, 4.0e0, 5.0e0) "
+                    "t(x)")
+    assert got == [[3.0, 1.0, 5.0]]
+
+
+def test_qdigest_agg_and_merge(runner):
+    got = q(runner, "SELECT value_at_quantile(merge(d), 0.5e0) FROM ("
+                    "SELECT qdigest_agg(x) AS d FROM (VALUES 1, 2, 3) "
+                    "t(x) UNION ALL SELECT qdigest_agg(x) "
+                    "FROM (VALUES 4, 5) t(x)) u")
+    assert got == [[3]]
+
+
+def test_tdigest_quantile_accuracy_large(runner, li):
+    vals, _ = li
+    import numpy as np
+    prices = np.sort(vals[:, 2])
+    got = q(runner, "SELECT value_at_quantile(tdigest_agg("
+                    "l_extendedprice), 0.5e0) FROM tpch.tiny.lineitem")
+    exact = float(np.quantile(prices, 0.5))
+    assert abs(got[0][0] - exact) / exact < 0.05
+
+
+def test_values_at_quantiles(runner):
+    got = q(runner, "SELECT values_at_quantiles(tdigest_agg(x), "
+                    "ARRAY[0.0e0, 0.5e0, 1.0e0]) "
+                    "FROM (VALUES 10.0e0, 20.0e0, 30.0e0) t(x)")
+    assert got == [[[10.0, 20.0, 30.0]]]
+
+
+def test_quantile_at_value(runner):
+    got = q(runner, "SELECT quantile_at_value(tdigest_agg(x), 15.0e0) "
+                    "FROM (VALUES 10.0e0, 20.0e0) t(x)")
+    assert abs(got[0][0] - 0.5) < 0.26
+
+
+def test_grouped_tdigest(runner):
+    got = q(runner, "SELECT g, value_at_quantile(tdigest_agg(x), 0.5e0) "
+                    "FROM (VALUES (1, 1.0e0), (1, 3.0e0), (1, 5.0e0), "
+                    "(2, 10.0e0)) t(g, x) GROUP BY g ORDER BY g")
+    assert got == [[1, 3.0], [2, 10.0]]
+
+
+def test_numeric_histogram_weighted(runner):
+    got = q(runner, "SELECT numeric_histogram(2, x, w) FROM (VALUES "
+                    "(1.0e0, 5.0e0), (2.0e0, 1.0e0), (100.0e0, 2.0e0))"
+                    " t(x, w)")
+    (m,), = got
+    assert m == {(1.0 * 5 + 2.0 * 1) / 6: 6.0, 100.0: 2.0}
+
+
+def test_empty_approx_set_merges_with_approx_set(runner):
+    got = q(runner, "SELECT cardinality(merge(d)) FROM ("
+                    "SELECT approx_set(x) AS d FROM (VALUES 1, 2, 3) "
+                    "t(x) UNION ALL SELECT empty_approx_set()) u")
+    assert got == [[3]]
+
+
+def test_values_fallback_many_rows(runner):
+    rows = ", ".join(f"map(ARRAY[{i}], ARRAY[{i}])" for i in range(60))
+    got = q(runner, f"SELECT cardinality(map_union(m)) "
+                    f"FROM (VALUES {rows}) t(m)")
+    assert got == [[60]]

@@ -149,3 +149,139 @@ def test_year_of_week(runner):
 def test_random(runner):
     got = q(runner, "SELECT random(), random(10) FROM lineitem LIMIT 5")
     assert all(0.0 <= r[0] < 1.0 and 0 <= r[1] < 10 for r in got)
+
+
+# -- round-4 scalar breadth -------------------------------------------------
+
+def test_hmac(runner):
+    import hashlib
+    import hmac as hm
+    exp = hm.new(b"key", b"hello", hashlib.sha256).hexdigest()
+    assert q(runner, "SELECT hmac_sha256('hello', 'key')") == [[exp]]
+    exp = hm.new(b"k", b"v", hashlib.md5).hexdigest()
+    assert q(runner, "SELECT hmac_md5('v', 'k')") == [[exp]]
+
+
+def test_utf8_roundtrip(runner):
+    assert q(runner, "SELECT from_utf8(to_utf8('héllo'))") == [["héllo"]]
+
+
+def test_big_endian_roundtrip(runner):
+    assert q(runner, "SELECT from_big_endian_64(to_big_endian_64(x)) "
+                     "FROM (VALUES 0, 1, -1, 1234567890123) t(x)") == \
+        [[0], [1], [-1], [1234567890123]]
+
+
+def test_ieee754_roundtrip(runner):
+    assert q(runner, "SELECT from_ieee754_64(to_ieee754_64(x)) "
+                     "FROM (VALUES 0.5e0, -2.25e0) t(x)") == \
+        [[0.5], [-2.25]]
+
+
+def test_bar(runner):
+    (b,), = q(runner, "SELECT bar(0.5e0, 10)")
+    assert len(b) == 10 and b.startswith("█████ ")
+
+
+def test_parse_format_datetime(runner):
+    got = q(runner, "SELECT format_datetime(TIMESTAMP "
+                    "'2001-08-22 03:04:05.321', 'yyyy-MM-dd HH:mm:ss')")
+    assert got == [["2001-08-22 03:04:05"]]
+    got = q(runner, "SELECT year(parse_datetime('2020/06/10', "
+                    "'yyyy/MM/dd'))")
+    assert got == [[2020]]
+
+
+def test_from_iso8601(runner):
+    import datetime
+    got = q(runner, "SELECT from_iso8601_date('2020-05-11'), "
+                    "hour(from_iso8601_timestamp("
+                    "'2020-05-11T11:15:05+02:00'))")
+    assert got == [[datetime.date(2020, 5, 11), 11]]
+
+
+def test_last_day_of_month(runner):
+    import datetime
+    assert q(runner, "SELECT last_day_of_month(DATE '2024-02-11'), "
+                     "last_day_of_month(DATE '2023-02-01')") == \
+        [[datetime.date(2024, 2, 29), datetime.date(2023, 2, 28)]]
+
+
+def test_timezone_parts(runner):
+    got = q(runner, "SELECT timezone_hour(from_iso8601_timestamp("
+                    "'2020-05-11T11:15:05+05:30')), "
+                    "timezone_minute(from_iso8601_timestamp("
+                    "'2020-05-11T11:15:05+05:30'))")
+    assert got == [[5, 30]]
+
+
+def test_word_stem(runner):
+    assert q(runner, "SELECT word_stem('running'), word_stem('cats'), "
+                     "word_stem('nationalization')") == \
+        [["run", "cat", "nationalize"]]
+
+
+def test_json_parse_format(runner):
+    assert q(runner, "SELECT json_format(json_parse("
+                     "' {\"a\" : 1, \"b\": [1, 2]} '))") == \
+        [['{"a":1,"b":[1,2]}']]
+
+
+def test_cosine_similarity(runner):
+    got = q(runner, "SELECT cosine_similarity("
+                    "map(ARRAY['a', 'b'], ARRAY[1.0e0, 2.0e0]), "
+                    "map(ARRAY['a', 'b'], ARRAY[1.0e0, 2.0e0]))")
+    assert abs(got[0][0] - 1.0) < 1e-12
+    got = q(runner, "SELECT cosine_similarity("
+                    "map(ARRAY['a'], ARRAY[1.0e0]), "
+                    "map(ARRAY['b'], ARRAY[1.0e0]))")
+    assert got == [[0.0]]
+
+
+def test_array_remove_zip(runner):
+    assert q(runner, "SELECT array_remove(ARRAY[1, 2, 1, 3], 1)") == \
+        [[[2, 3]]]
+    assert q(runner, "SELECT zip(ARRAY[1, 2], ARRAY['a', 'b', 'c'])") \
+        == [[[[1, "a"], [2, "b"], [None, "c"]]]]
+
+
+def test_ngrams_combinations(runner):
+    assert q(runner, "SELECT ngrams(ARRAY['a', 'b', 'c', 'd'], 2)") == \
+        [[[["a", "b"], ["b", "c"], ["c", "d"]]]]
+    assert q(runner, "SELECT combinations(ARRAY[1, 2, 3], 2)") == \
+        [[[[1, 2], [1, 3], [2, 3]]]]
+
+
+def test_array_first_last(runner):
+    assert q(runner, "SELECT array_first(ARRAY[5, 6, 7]), "
+                     "array_last(ARRAY[5, 6, 7])") == [[5, 7]]
+
+
+def test_map_from_entries(runner):
+    got = q(runner, "SELECT map_from_entries(ARRAY["
+                    "ROW('a', 1), ROW('b', 2)])")
+    assert got == [[{"a": 1, "b": 2}]]
+    got = q(runner, "SELECT multimap_from_entries(ARRAY["
+                    "ROW('a', 1), ROW('a', 2), ROW('b', 3)])")
+    assert got == [[{"a": [1, 2], "b": [3]}]]
+
+
+def test_split_to_multimap(runner):
+    got = q(runner, "SELECT split_to_multimap("
+                    "'a=1,b=2,a=3', ',', '=')")
+    assert got == [[{"a": ["1", "3"], "b": ["2"]}]]
+
+
+def test_hmac_over_varbinary_bytes(runner):
+    import hashlib
+    import hmac as hm
+    import struct
+    exp = hm.new(b"k", struct.pack(">q", 200), hashlib.sha256).hexdigest()
+    assert q(runner, "SELECT hmac_sha256(to_big_endian_64(200), 'k')") \
+        == [[exp]]
+
+
+def test_format_datetime_millis_no_collision(runner):
+    got = q(runner, "SELECT format_datetime(TIMESTAMP "
+                    "'2024-01-01 00:10:00.001', 'HHmmSSS')")
+    assert got == [["0010001"]]

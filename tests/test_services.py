@@ -132,3 +132,51 @@ def test_build_catalogs_from_etc(tmp_path):
     runner = LocalQueryRunner(catalogs=mgr)
     assert runner.execute("SELECT count(*) FROM "
                           "analytics.tiny.region").rows == [[5]]
+
+
+# -- GRANT / REVOKE / DENY / SHOW GRANTS (round 4) --------------------------
+
+def test_grant_revoke_show_grants():
+    from trino_tpu.runner import LocalQueryRunner
+    r = LocalQueryRunner()
+    r.execute("CREATE TABLE memory.default.gr_t AS SELECT 1 AS x")
+    r.execute("GRANT SELECT, INSERT ON memory.default.gr_t TO alice")
+    rows = r.execute("SHOW GRANTS ON memory.default.gr_t").rows
+    assert sorted(x[7] for x in rows) == ["INSERT", "SELECT"]
+    assert all(x[2] == "alice" for x in rows)
+    r.execute("REVOKE INSERT ON memory.default.gr_t FROM alice")
+    rows = r.execute("SHOW GRANTS ON memory.default.gr_t").rows
+    assert [x[7] for x in rows] == ["SELECT"]
+    r.execute("GRANT ALL PRIVILEGES ON TABLE memory.default.gr_t "
+              "TO USER bob WITH GRANT OPTION")
+    rows = r.execute("SHOW GRANTS").rows
+    bob = [x for x in rows if x[2] == "bob"]
+    assert len(bob) == 4 and all(x[8] is True for x in bob)
+
+
+def test_grant_enforcement():
+    import pytest
+    from trino_tpu.catalog import CatalogManager
+    from trino_tpu.connectors.memory import MemoryConnector
+    from trino_tpu.runner import LocalQueryRunner, QueryError
+    from trino_tpu.security import GrantBasedAccessControl
+    from trino_tpu.session import Session
+
+    cats = CatalogManager()
+    cats.register("memory", MemoryConnector())
+    admin = LocalQueryRunner(
+        session=Session(catalog="memory", schema="default", user="admin"),
+        catalogs=cats)
+    admin.execute("CREATE TABLE memory.default.sec_t AS SELECT 1 AS x")
+    cats.access_control = GrantBasedAccessControl(cats)
+    alice = LocalQueryRunner(
+        session=Session(catalog="memory", schema="default", user="alice"),
+        catalogs=cats)
+    with pytest.raises((QueryError, Exception), match="Access Denied"):
+        alice.execute("SELECT * FROM memory.default.sec_t")
+    admin.execute("GRANT SELECT ON memory.default.sec_t TO alice")
+    assert alice.execute(
+        "SELECT * FROM memory.default.sec_t").rows == [[1]]
+    admin.execute("DENY SELECT ON memory.default.sec_t TO alice")
+    with pytest.raises((QueryError, Exception), match="Access Denied"):
+        alice.execute("SELECT * FROM memory.default.sec_t")

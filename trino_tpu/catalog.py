@@ -197,6 +197,13 @@ class CatalogManager:
         # AccessControl SPI consulted by the planner/runner (None =
         # allow all; security/AccessControlManager.java)
         self.access_control = access_control
+        # engine-level grant store (reference routes GRANT to connector
+        # metadata — MetadataManager.grantTablePrivileges; ours is
+        # engine-scoped so every connector gets GRANT support):
+        # (grantee, privilege, catalog, schema, table) -> grantable
+        self.grants: Dict[Tuple[str, str, str, str, str], bool] = {}
+        # DENY entries (same key; deny wins over grant)
+        self.denies: set = set()
 
     # --- views -----------------------------------------------------------
     def create_view(self, catalog: str, schema: str, name: str,

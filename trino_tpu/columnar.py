@@ -391,7 +391,8 @@ class Batch:
                      else np.asarray(c.valid)[:n])
             t = c.type
             col: List = []
-            if is_string(t):
+            if is_string(t) or (c.dictionary is not None
+                                and t.name == "varbinary"):
                 vals = c.dictionary.values
                 for i in range(n):
                     col.append(str(vals[int(data[i])]) if valid[i] else None)
@@ -426,6 +427,12 @@ class Batch:
                                          np.asarray(c.data2)[:n],
                                          np.asarray(c.elements.data),
                                          t.bucket_bits)
+                col = [(enc[i] if valid[i] else None) for i in range(n)]
+            elif t.name == "tdigest" or t.name.startswith("qdigest("):
+                from .ops.digest import sketches_to_base64 as _d64
+                enc = _d64(data[:n], np.asarray(c.data2)[:n],
+                           np.asarray(c.elements.data),
+                           np.asarray(c.elements2.data))
                 col = [(enc[i] if valid[i] else None) for i in range(n)]
             elif t.name.startswith("array("):
                 # materialize the flat elements once, slice per row

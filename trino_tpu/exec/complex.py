@@ -424,7 +424,10 @@ def concat_columns_host(cols, counts, cap: int) -> Column:
     rebased offsets."""
     from ..columnar import _pad
     typ = cols[0].type
-    if isinstance(typ, (ArrayType, MapType)):
+    if cols[0].elements is not None:
+        # every offsets+pool column concatenates the same way: ARRAY,
+        # MAP, and the sketch types (hyperloglog / tdigest / qdigest)
+        # share the {data=start, data2=len, elements[,elements2]} layout
         canons = [canonicalize(c, n) for c, n in zip(cols, counts)]
         pools = [c.elements for c in canons]
         pool = _concat_flat(pools)
@@ -1306,3 +1309,336 @@ DISPATCH = {
     "transform_values": _map_lambda("values"),
     "map_zip_with": _map_zip_with,
 }
+
+
+# --------------------------------------------------------------------------
+# round-4 additions: zip / ngrams / combinations / array_remove /
+# map_from_entries / multimap_from_entries / split_to_multimap /
+# cosine_similarity (reference: operator/scalar/{ZipFunction,
+# ArrayNgramsFunction,CombinationsFunction,ArrayRemoveFunction,
+# MapFromEntriesFunction,MultimapFromEntriesFunction,StringFunctions,
+# MathFunctions}.java)
+# --------------------------------------------------------------------------
+
+from dataclasses import replace as _dc_replace  # noqa: E402
+
+
+def _array_remove(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    probe = _eval(e.args[1], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    el = canon.elements
+    lane, pl = _comparable_lane(el, total, probe)
+    # drop where element == probe; NULL probe or NULL element: keep
+    drop = lane == pl[owner] if total else np.zeros(0, bool)
+    if el.valid is not None:
+        drop &= _np(el.valid)[:total].astype(bool)
+    drop &= _valid_np(probe, cap)[owner]
+    keep = np.nonzero(~drop)[0]
+    k_owner = owner[keep]
+    lens = np.bincount(k_owner, minlength=cap).astype(np.int64)[:cap]
+    offs = np.concatenate([[0], np.cumsum(lens)[:-1]]).astype(np.int64)
+    return Column(e.type, offs, None if arr.valid is None
+                  else _valid_np(arr, cap), None, lens,
+                  _take_flat(el, keep))
+
+
+def _zip_fn(e: Call, batch: Batch) -> Column:
+    cap = batch.capacity
+    arrs = [canonicalize(_eval(a, batch), cap) for a in e.args]
+    lens = [np.where(_valid_np(a, cap),
+                     _np(a.data2)[:cap].astype(np.int64), 0)
+            for a in arrs]
+    valid = np.ones(cap, bool)
+    for a in arrs:
+        valid &= _valid_np(a, cap)
+    out_len = np.where(valid, np.maximum.reduce(lens), 0)
+    offs = np.concatenate([[0], np.cumsum(out_len)[:-1]]).astype(np.int64)
+    total = _host_int(out_len.sum())
+    owner = np.repeat(np.arange(cap, dtype=np.int64), out_len)
+    j = np.arange(total, dtype=np.int64) - np.repeat(offs, out_len)
+    children = []
+    for a, ln in zip(arrs, lens):
+        src = _np(a.data)[:cap].astype(np.int64)[owner] + j
+        present = j < ln[owner]
+        ch = _take_flat(a.elements, np.where(present, src, 0))
+        chv = (present if ch.valid is None
+               else (np.asarray(ch.valid, bool) & present))
+        children.append(_dc_replace(ch, valid=chv))
+    row_el = Column(e.type.element, np.zeros(total, np.int8), None,
+                    children=tuple(children))
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  out_len, row_el)
+
+
+def _ngrams(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    ne = e.args[1]
+    from .expr import Const as _Const
+    if not isinstance(ne, _Const) or ne.value is None or int(ne.value) < 1:
+        raise _err()("ngrams: n must be a positive constant")
+    n = int(ne.value)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    valid = _valid_np(arr, cap)
+    lens = np.where(valid, _np(canon.data2)[:cap].astype(np.int64), 0)
+    offs = _np(canon.data)[:cap].astype(np.int64)
+    cnt = np.where(valid, np.maximum(lens - n + 1, 1), 0)
+    out_offs = np.concatenate([[0], np.cumsum(cnt)[:-1]]).astype(np.int64)
+    total = _host_int(cnt.sum())
+    owner = np.repeat(np.arange(cap, dtype=np.int64), cnt)
+    j = np.arange(total, dtype=np.int64) - np.repeat(out_offs, cnt)
+    in_offs = offs[owner] + j
+    in_lens = np.minimum(n, lens[owner] - j)
+    inner = Column(e.type.element, in_offs, None, None,
+                   np.maximum(in_lens, 0), canon.elements)
+    return Column(e.type, out_offs, None if valid.all() else valid,
+                  None, cnt, inner)
+
+
+def _combinations(e: Call, batch: Batch) -> Column:
+    import itertools
+    arr = _eval(e.args[0], batch)
+    ne = e.args[1]
+    from .expr import Const as _Const
+    if not isinstance(ne, _Const) or ne.value is None:
+        raise _err()("combinations: n must be a constant")
+    n = int(ne.value)
+    if n < 0 or n > 5:
+        raise _err()("combinations: n must be in [0, 5]")
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    valid = _valid_np(arr, cap)
+    lens = np.where(valid, _np(canon.data2)[:cap].astype(np.int64), 0)
+    offs = _np(canon.data)[:cap].astype(np.int64)
+    pool_idx = []
+    cnt = np.zeros(cap, np.int64)
+    inner_offs = []
+    inner_lens = []
+    for r in range(cap):
+        if not valid[r]:
+            continue
+        k = 0
+        for combo in itertools.combinations(range(int(lens[r])), n):
+            inner_offs.append(len(pool_idx))
+            inner_lens.append(n)
+            pool_idx.extend(offs[r] + i for i in combo)
+            k += 1
+        cnt[r] = k
+    out_offs = np.concatenate([[0], np.cumsum(cnt)[:-1]]).astype(np.int64)
+    total = _host_int(cnt.sum())
+    io = np.zeros(max(total, 1), np.int64)
+    il = np.zeros(max(total, 1), np.int64)
+    io[:total] = inner_offs
+    il[:total] = inner_lens
+    pool = _take_flat(canon.elements,
+                      np.asarray(pool_idx, dtype=np.int64))
+    inner = Column(e.type.element, io[:max(total, 1)], None, None,
+                   il[:max(total, 1)], pool)
+    return Column(e.type, out_offs, None if valid.all() else valid,
+                  None, cnt, inner)
+
+
+def _array_end(which: str):
+    def f(e: Call, batch: Batch) -> Column:
+        arr = _eval(e.args[0], batch)
+        cap = batch.capacity
+        canon = canonicalize(arr, cap)
+        valid = _valid_np(arr, cap)
+        lens = _np(canon.data2)[:cap].astype(np.int64)
+        offs = _np(canon.data)[:cap].astype(np.int64)
+        nonempty = valid & (lens > 0)
+        idx = np.where(which == "first", offs, offs + lens - 1)
+        el = _take_flat(canon.elements,
+                        np.where(nonempty, idx, 0))
+        ev = (nonempty if el.valid is None
+              else np.asarray(el.valid, bool) & nonempty)
+        return _dc_replace(el, valid=ev)
+    return f
+
+
+def _entry_children(canon: Column, total: int):
+    row_el = canon.elements
+    if row_el.children is None or len(row_el.children) != 2:
+        raise _err()("map_from_entries requires array(row(K, V))")
+    if row_el.valid is not None \
+            and not np.asarray(row_el.valid, bool)[:total].all():
+        raise _err()("map entry cannot be null")
+    return row_el.children
+
+
+def _map_from_entries(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    kcol, vcol = _entry_children(canon, total)
+    lane, _ = _comparable_lane(kcol, total)
+    kv = (np.ones(total, bool) if kcol.valid is None
+          else np.asarray(kcol.valid, bool)[:total])
+    if not kv.all():
+        raise _err()("map key cannot be null")
+    pairs = set()
+    for i in range(total):
+        key = (int(owner[i]), int(lane[i]))
+        if key in pairs:
+            raise _err()("Duplicate map keys are not allowed")
+        pairs.add(key)
+    return Column(e.type, canon.data, canon.valid, None, canon.data2,
+                  _take_flat(kcol, np.arange(total, dtype=np.int64)),
+                  _take_flat(vcol, np.arange(total, dtype=np.int64)))
+
+
+def _multimap_from_entries(e: Call, batch: Batch) -> Column:
+    arr = _eval(e.args[0], batch)
+    cap = batch.capacity
+    canon = canonicalize(arr, cap)
+    owner = _owners(canon, cap)
+    total = len(owner)
+    kcol, vcol = _entry_children(canon, total)
+    lane, _ = _comparable_lane(kcol, total)
+    out_len = np.zeros(cap, np.int64)
+    key_rows = []
+    val_rows = []
+    arr_offs = []
+    arr_lens = []
+    i = 0
+    while i < total:
+        r = owner[i]
+        per = {}
+        order_k = []
+        while i < total and owner[i] == r:
+            k = int(lane[i])
+            if k not in per:
+                per[k] = (i, [])
+                order_k.append(k)
+            per[k][1].append(i)
+            i += 1
+        for k in order_k:
+            rep, rows = per[k]
+            key_rows.append(rep)
+            arr_offs.append(len(val_rows))
+            arr_lens.append(len(rows))
+            val_rows.extend(rows)
+        out_len[r] = len(order_k)
+    offs = np.concatenate([[0], np.cumsum(out_len)[:-1]]).astype(np.int64)
+    nk = max(len(key_rows), 1)
+    io = np.zeros(nk, np.int64)
+    il = np.zeros(nk, np.int64)
+    io[:len(arr_offs)] = arr_offs
+    il[:len(arr_lens)] = arr_lens
+    varr = Column(e.type.value, io, None, None, il,
+                  _take_flat(vcol, np.asarray(val_rows, np.int64)))
+    return Column(e.type, offs, canon.valid, None, out_len,
+                  _take_flat(kcol, np.asarray(key_rows, np.int64)), varr)
+
+
+def _split_to_multimap(e: Call, batch: Batch) -> Column:
+    from .expr import _materialize_strings, Const as _Const
+    s = _eval(e.args[0], batch)
+    d1, d2 = e.args[1], e.args[2]
+    if not isinstance(d1, _Const) or not isinstance(d2, _Const):
+        raise _err()("split_to_multimap: delimiters must be constants")
+    ed, kd = str(d1.value), str(d2.value)
+    cap = batch.capacity
+    mats = _materialize_strings(s)
+    valid = np.asarray([m is not None for m in mats], bool)
+    keys = []
+    vals = []
+    out_len = np.zeros(cap, np.int64)
+    arr_offs = []
+    arr_lens = []
+    flat_vals = []
+    for r, m in enumerate(mats):
+        if m is None:
+            continue
+        per = {}
+        order_k = []
+        if m:
+            for entry in m.split(ed):
+                k, _, v = entry.partition(kd)
+                if k not in per:
+                    per[k] = []
+                    order_k.append(k)
+                per[k].append(v)
+        for k in order_k:
+            keys.append(k)
+            arr_offs.append(len(flat_vals))
+            arr_lens.append(len(per[k]))
+            flat_vals.extend(per[k])
+        out_len[r] = len(order_k)
+    offs = np.concatenate([[0], np.cumsum(out_len)[:-1]]).astype(np.int64)
+    kd_, kcodes = StringDictionary.from_strings(keys)
+    vd_, vcodes = StringDictionary.from_strings(flat_vals)
+    nk = max(len(keys), 1)
+    nv = max(len(flat_vals), 1)
+    kc = np.zeros(nk, np.int32)
+    kc[:len(keys)] = kcodes
+    vc = np.zeros(nv, np.int32)
+    vc[:len(flat_vals)] = vcodes
+    io = np.zeros(nk, np.int64)
+    il = np.zeros(nk, np.int64)
+    io[:len(arr_offs)] = arr_offs
+    il[:len(arr_lens)] = arr_lens
+    varr = Column(e.type.value, io, None, None, il,
+                  Column(VARCHAR, vc, None, vd_))
+    return Column(e.type, offs, None if valid.all() else valid, None,
+                  out_len, Column(VARCHAR, kc, None, kd_), varr)
+
+
+def _cosine_similarity(e: Call, batch: Batch) -> Column:
+    import math
+    cap = batch.capacity
+    m1 = canonicalize(_eval(e.args[0], batch), cap)
+    m2 = canonicalize(_eval(e.args[1], batch), cap)
+    valid = _valid_np(m1, cap) & _valid_np(m2, cap)
+
+    def rowmaps(m):
+        offs = _np(m.data)[:cap].astype(np.int64)
+        lens = _np(m.data2)[:cap].astype(np.int64)
+        kl = m.elements
+        kd = kl.dictionary.values if kl.dictionary is not None else None
+        kdata = _np(kl.data)
+        vdata = _np(m.elements2.data).astype(np.float64)
+        out = []
+        for r in range(cap):
+            d = {}
+            for j in range(int(offs[r]), int(offs[r] + lens[r])):
+                key = (str(kd[int(kdata[j])]) if kd is not None
+                       else kdata[j].item())
+                d[key] = float(vdata[j])
+            out.append(d)
+        return out
+    a, b = rowmaps(m1), rowmaps(m2)
+    out = np.zeros(cap, np.float64)
+    ok = valid.copy()
+    for r in range(cap):
+        if not valid[r]:
+            continue
+        na = math.sqrt(sum(v * v for v in a[r].values()))
+        nb = math.sqrt(sum(v * v for v in b[r].values()))
+        if na == 0.0 or nb == 0.0:
+            ok[r] = False
+            continue
+        dot = sum(v * b[r].get(k, 0.0) for k, v in a[r].items())
+        out[r] = dot / (na * nb)
+    from ..types import DOUBLE as _DOUBLE
+    return Column(_DOUBLE, out, None if ok.all() else ok)
+
+
+DISPATCH.update({
+    "array_remove": _array_remove,
+    "zip": _zip_fn,
+    "ngrams": _ngrams,
+    "combinations": _combinations,
+    "array_first": _array_end("first"),
+    "array_last": _array_end("last"),
+    "map_from_entries": _map_from_entries,
+    "multimap_from_entries": _multimap_from_entries,
+    "split_to_multimap": _split_to_multimap,
+    "cosine_similarity": _cosine_similarity,
+})

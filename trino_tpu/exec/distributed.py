@@ -240,7 +240,10 @@ class DistributedExecutor(Executor):
             return super()._exec_AggregationNode(
                 dc_replace(node, source=_Pre(src)))
         if any(a.kind in ("array_agg", "map_agg", "histogram",
-                          "approx_most_frequent")
+                          "approx_most_frequent", "map_union",
+                          "multimap_agg", "numeric_histogram",
+                          "tdigest_agg", "qdigest_agg",
+                          "approx_set", "merge")
                for a in node.aggregates.values()):
             # array/map offsets don't survive shard-local numbering;
             # gather to the coordinator shard and aggregate locally

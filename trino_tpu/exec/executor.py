@@ -173,7 +173,8 @@ class Executor:
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
                       "approx_percentile", "array_agg", "map_agg",
                       "histogram", "approx_most_frequent",
-                      "approx_set", "merge"}
+                      "approx_set", "merge", "map_union", "multimap_agg",
+                      "numeric_histogram", "tdigest_agg", "qdigest_agg"}
 
     def _try_streaming_aggregation(self, node: AggregationNode):
         # kinds whose partials don't combine with a single-lane segment
@@ -1138,6 +1139,10 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
             phys.append(AggInput("sum", lsym, a.mask, ssym))
             phys.append(AggInput("count", lsym, a.mask, csym))
             post[sym] = _geomean_post(ssym, csym)
+        elif kind in ("bitwise_and_agg", "bitwise_or_agg"):
+            phys.append(AggInput(
+                "bit_and" if kind == "bitwise_and_agg" else "bit_or",
+                a.argument, a.mask, sym))
         elif kind in ("min_by", "max_by"):
             phys.append(AggInput(
                 "argmin" if kind == "min_by" else "argmax",
@@ -1155,12 +1160,30 @@ def _lower_aggregates(aggregates: Dict[str, Aggregate], src: Batch):
             phys.append(AggInput("hll", a.argument, a.mask, sym,
                                  param=float(b)))
         elif kind == "merge":
-            phys.append(AggInput("hll_merge", a.argument, a.mask, sym))
+            from ..types import QDigestType, TDigestType
+            argt = src.column(a.argument).type
+            mk = ("digest_merge"
+                  if isinstance(argt, (TDigestType, QDigestType))
+                  else "hll_merge")
+            phys.append(AggInput(mk, a.argument, a.mask, sym))
+        elif kind in ("tdigest_agg", "qdigest_agg"):
+            phys.append(AggInput(
+                "tdigest" if kind == "tdigest_agg" else "qdigest",
+                a.argument, a.mask, sym, input2=a.argument2,
+                param=a.param))
         elif kind == "array_agg":
             phys.append(AggInput("array_agg", a.argument, a.mask, sym))
         elif kind == "map_agg":
             phys.append(AggInput("map_agg", a.argument, a.mask, sym,
                                  input2=a.argument2))
+        elif kind == "map_union":
+            phys.append(AggInput("map_union", a.argument, a.mask, sym))
+        elif kind == "multimap_agg":
+            phys.append(AggInput("multimap_agg", a.argument, a.mask, sym,
+                                 input2=a.argument2))
+        elif kind == "numeric_histogram":
+            phys.append(AggInput("numeric_histogram", a.argument, a.mask,
+                                 sym, input2=a.argument2, param=a.param))
         elif kind == "histogram":
             phys.append(AggInput("histogram", a.argument, a.mask, sym))
         elif kind == "approx_most_frequent":

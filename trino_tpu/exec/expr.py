@@ -1425,11 +1425,14 @@ def _cardinality(e, batch):
 
 def _empty_approx_set(e, batch):
     """Constant empty HLL sketch per row (HyperLogLogFunctions.java):
-    zero sparse entries."""
-    from ..types import HYPER_LOG_LOG, INTEGER
+    zero sparse entries. Bucket bits match approx_set's default so
+    merge(coalesce(approx_set(x), empty_approx_set())) type-checks."""
+    from ..ops.hll import APPROX_SET_BUCKET_BITS
+    from ..types import HyperLogLogType, INTEGER
     cap = batch.capacity
     empty = Column(INTEGER, jnp.zeros((8,), jnp.int32))
-    return Column(HYPER_LOG_LOG, jnp.zeros((cap,), jnp.int64), None,
+    return Column(HyperLogLogType(APPROX_SET_BUCKET_BITS),
+                  jnp.zeros((cap,), jnp.int64), None,
                   None, jnp.zeros((cap,), jnp.int64), empty)
 
 
@@ -2229,3 +2232,506 @@ from . import complex as _complex  # noqa: E402
 
 for _name, _fn in _complex.DISPATCH.items():
     _DISPATCH.setdefault(_name, _fn)
+
+
+# --------------------------------------------------------------------------
+# round-4 scalar breadth: HMAC, binary codecs, joda datetime, bar charts,
+# porter stemmer (reference: operator/scalar/{HmacFunctions,
+# VarbinaryFunctions,DateTimeFunctions,ColorFunctions,WordStemFunction}.java)
+# --------------------------------------------------------------------------
+
+def _carried_bytes(typ) -> Callable[[str], bytes]:
+    """varbinary values are carried as latin-1-decoded strings
+    (_num_to_binary); varchar is real text -> utf-8."""
+    if getattr(typ, "name", "") == "varbinary":
+        return lambda s: s.encode("latin-1")
+    return lambda s: s.encode()
+
+
+def _hmac(algo):
+    def f(e, batch):
+        import hashlib
+        import hmac as _hm
+        a = eval_expr(e.args[0], batch)
+        k = eval_expr(e.args[1], batch)
+        vb = _carried_bytes(a.type)
+        kb = _carried_bytes(k.type)
+        return _row_string_fn(
+            [a, k],
+            lambda v, key: _hm.new(kb(key), vb(v),
+                                   getattr(hashlib, algo)).hexdigest(),
+            e.type)
+    return f
+
+
+def _retype_string(e, batch):
+    """to_utf8 / from_utf8 / json_format: identity on the carried string,
+    retyped (varbinary is a dictionary column like varchar)."""
+    a = eval_expr(e.args[0], batch)
+    if a.dictionary is None:
+        return dc_replace(a, type=e.type)
+    return Column(e.type, a.data, a.valid, a.dictionary)
+
+
+def _json_parse(e, batch):
+    import json as _json
+    a = eval_expr(e.args[0], batch)
+
+    def canon(v: str):
+        try:
+            return _json.dumps(_json.loads(v), separators=(",", ":"),
+                               sort_keys=False)
+        except ValueError:
+            raise EvalError(f"Cannot convert value to JSON: '{v}'")
+    return _dict_transform(a, canon, e.type)
+
+
+def _num_to_binary(pack):
+    def f(e, batch):
+        a = eval_expr(e.args[0], batch)
+        vals = np.asarray(a.data)
+        valid = None if a.valid is None else np.asarray(a.valid)
+        out = []
+        for i in range(vals.shape[0]):
+            if valid is not None and not valid[i]:
+                out.append(None)
+            else:
+                out.append(pack(vals[i]).decode("latin-1"))
+        d, codes = StringDictionary.from_strings(out)
+        v = np.asarray([o is not None for o in out], bool)
+        return Column(e.type, jnp.asarray(codes),
+                      None if v.all() else jnp.asarray(v), d)
+    return f
+
+
+def _binary_to_num(unpack):
+    def f(e, batch):
+        a = eval_expr(e.args[0], batch)
+        return _dict_transform(
+            a, lambda s: unpack(s.encode("latin-1")), e.type)
+    return f
+
+
+def _bar_fn(e, batch):
+    """bar(x, width): unicode block bar (reference renders ANSI color
+    ramps; the bar geometry matches, color is omitted)."""
+    a = eval_expr(e.args[0], batch)
+    w = e.args[1]
+    if not isinstance(w, Const) or w.value is None:
+        raise EvalError("bar: width must be a constant")
+    width = int(w.value)
+    vals = np.asarray(a.data).astype(np.float64)
+    valid = None if a.valid is None else np.asarray(a.valid)
+    out = []
+    for i in range(vals.shape[0]):
+        if valid is not None and not valid[i]:
+            out.append(None)
+            continue
+        x = min(max(float(vals[i]), 0.0), 1.0)
+        n = int(round(x * width))
+        out.append("█" * n + " " * (width - n))
+    d, codes = StringDictionary.from_strings(out)
+    v = np.asarray([o is not None for o in out], bool)
+    return Column(e.type, jnp.asarray(codes),
+                  None if v.all() else jnp.asarray(v), d)
+
+
+_JODA_TOKENS = [
+    ("yyyy", "%Y"), ("yyy", "%Y"), ("yy", "%y"), ("y", "%Y"),
+    ("MMMM", "%B"), ("MMM", "%b"), ("MM", "%m"), ("M", "%m"),
+    ("dd", "%d"), ("d", "%d"), ("EEEE", "%A"), ("EEE", "%a"),
+    ("HH", "%H"), ("H", "%H"), ("hh", "%I"), ("h", "%I"),
+    ("mm", "%M"), ("m", "%M"), ("ss", "%S"), ("s", "%S"),
+    ("SSS", "%f"), ("a", "%p"), ("ZZ", "%z"), ("Z", "%z"),
+]
+
+
+def _joda_to_strptime(fmt: str) -> str:
+    out = []
+    i = 0
+    while i < len(fmt):
+        if fmt[i] == "'":
+            j = fmt.find("'", i + 1)
+            if j < 0:
+                out.append(fmt[i + 1:])
+                break
+            out.append(fmt[i + 1:j].replace("%", "%%"))
+            i = j + 1
+            continue
+        for tok, rep in _JODA_TOKENS:
+            if fmt.startswith(tok, i):
+                out.append(rep)
+                i += len(tok)
+                break
+        else:
+            out.append(fmt[i].replace("%", "%%"))
+            i += 1
+    return "".join(out)
+
+
+def _parse_datetime(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    fe = e.args[1]
+    if not isinstance(fe, Const) or fe.value is None:
+        raise EvalError("parse_datetime: format must be a constant")
+    fmt = _joda_to_strptime(str(fe.value))
+    codes = np.asarray(a.data)
+    valid = None if a.valid is None else np.asarray(a.valid)
+    vals = a.dictionary.values if a.dictionary is not None else None
+    data = np.zeros(codes.shape[0], np.int64)
+    data2 = np.zeros(codes.shape[0], np.int64)
+    ok = np.ones(codes.shape[0], bool)
+    for i in range(codes.shape[0]):
+        if valid is not None and not valid[i]:
+            ok[i] = False
+            continue
+        s = str(vals[int(codes[i])]) if vals is not None else str(codes[i])
+        # %f expects microseconds; joda SSS is millis — normalize
+        try:
+            t = _dt.datetime.strptime(s, fmt)
+        except ValueError as ex:
+            raise EvalError(f"parse_datetime: {ex}")
+        off = t.utcoffset()
+        offm = 0 if off is None else int(off.total_seconds() // 60)
+        naive = t.replace(tzinfo=None)
+        ms = int((naive - _dt.datetime(1970, 1, 1)).total_seconds()
+                 * 1000)
+        data[i] = ms - offm * 60000
+        data2[i] = offm
+    return Column(e.type, jnp.asarray(data),
+                  None if ok.all() else jnp.asarray(ok), None,
+                  jnp.asarray(data2))
+
+
+def _format_datetime(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    fe = e.args[1]
+    if not isinstance(fe, Const) or fe.value is None:
+        raise EvalError("format_datetime: format must be a constant")
+    fmt = _joda_to_strptime(str(fe.value))
+    vals = np.asarray(a.data)
+    offs = (np.asarray(a.data2) if a.data2 is not None
+            else np.zeros(vals.shape[0], np.int64))
+    valid = None if a.valid is None else np.asarray(a.valid)
+    epoch = _dt.datetime(1970, 1, 1)
+    from ..types import DATE as _DATE
+    out = []
+    for i in range(vals.shape[0]):
+        if valid is not None and not valid[i]:
+            out.append(None)
+            continue
+        if a.type is _DATE:
+            t = _dt.datetime.fromordinal(
+                int(vals[i]) + _dt.date(1970, 1, 1).toordinal())
+        else:
+            t = epoch + _dt.timedelta(
+                milliseconds=int(vals[i]) + int(offs[i]) * 60000)
+        # strftime %f prints micros; joda SSS is millis — substitute
+        # into the FORMAT (digits only, cannot collide with other
+        # directives) rather than find/replace on the formatted string
+        row_fmt = fmt.replace("%f", f"{t.microsecond // 1000:03d}")
+        out.append(t.strftime(row_fmt))
+    d, codes = StringDictionary.from_strings(out)
+    v = np.asarray([o is not None for o in out], bool)
+    return Column(e.type, jnp.asarray(codes),
+                  None if v.all() else jnp.asarray(v), d)
+
+
+def _from_iso8601_date(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    d0 = _dt.date(1970, 1, 1).toordinal()
+    return _dict_transform(
+        a, lambda s: _dt.date.fromisoformat(s[:10]).toordinal() - d0,
+        e.type)
+
+
+def _from_iso8601_timestamp(e, batch):
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    codes = np.asarray(a.data)
+    valid = None if a.valid is None else np.asarray(a.valid)
+    vals = a.dictionary.values if a.dictionary is not None else None
+    data = np.zeros(codes.shape[0], np.int64)
+    data2 = np.zeros(codes.shape[0], np.int64)
+    ok = np.ones(codes.shape[0], bool)
+    for i in range(codes.shape[0]):
+        if valid is not None and not valid[i]:
+            ok[i] = False
+            continue
+        s = str(vals[int(codes[i])]) if vals is not None else str(codes[i])
+        t = _dt.datetime.fromisoformat(s.replace("Z", "+00:00"))
+        off = t.utcoffset()
+        offm = 0 if off is None else int(off.total_seconds() // 60)
+        naive = t.replace(tzinfo=None)
+        data[i] = int((naive - _dt.datetime(1970, 1, 1)).total_seconds()
+                      * 1000) - offm * 60000
+        data2[i] = offm
+    return Column(e.type, jnp.asarray(data),
+                  None if ok.all() else jnp.asarray(ok), None,
+                  jnp.asarray(data2))
+
+
+def _last_day_of_month(e, batch):
+    import calendar
+    import datetime as _dt
+    a = eval_expr(e.args[0], batch)
+    vals = np.asarray(a.data)
+    valid = None if a.valid is None else np.asarray(a.valid)
+    d0 = _dt.date(1970, 1, 1).toordinal()
+    from ..types import DATE as _DATE
+    out = np.zeros(vals.shape[0], np.int64)
+    for i in range(vals.shape[0]):
+        if valid is not None and not valid[i]:
+            continue
+        if a.type is _DATE:
+            d = _dt.date.fromordinal(int(vals[i]) + d0)
+        else:
+            d = (_dt.datetime(1970, 1, 1)
+                 + _dt.timedelta(milliseconds=int(vals[i]))).date()
+        last = calendar.monthrange(d.year, d.month)[1]
+        out[i] = _dt.date(d.year, d.month, last).toordinal() - d0
+    return Column(e.type, jnp.asarray(out), a.valid)
+
+
+def _timezone_part(which):
+    def f(e, batch):
+        a = eval_expr(e.args[0], batch)
+        offs = (jnp.asarray(a.data2) if a.data2 is not None
+                else jnp.zeros(np.asarray(a.data).shape[0], jnp.int64))
+        if which == "hour":
+            data = jnp.sign(offs) * (jnp.abs(offs) // 60)
+        else:
+            data = jnp.sign(offs) * (jnp.abs(offs) % 60)
+        return Column(BIGINT, data.astype(jnp.int64), a.valid)
+    return f
+
+
+_PORTER_V = "aeiou"
+
+
+def _porter_stem(w: str) -> str:
+    """Compact Porter stemmer (step 1 + common suffixes) — covers the
+    usual analytics cases (plurals, -ing/-ed, -ation)."""
+    if len(w) <= 2:
+        return w
+    w = w.lower()
+
+    def meas(s):
+        m, prev_v = 0, False
+        for ch in s:
+            v = ch in _PORTER_V
+            if prev_v and not v:
+                m += 1
+            prev_v = v
+        return m
+
+    def has_vowel(s):
+        return any(c in _PORTER_V for c in s)
+
+    # step 1a
+    if w.endswith("sses"):
+        w = w[:-2]
+    elif w.endswith("ies"):
+        w = w[:-2]
+    elif w.endswith("ss"):
+        pass
+    elif w.endswith("s"):
+        w = w[:-1]
+    # step 1b
+    if w.endswith("eed"):
+        if meas(w[:-3]) > 0:
+            w = w[:-1]
+    elif w.endswith("ed") and has_vowel(w[:-2]):
+        w = w[:-2]
+        w = _porter_fixup(w)
+    elif w.endswith("ing") and has_vowel(w[:-3]):
+        w = w[:-3]
+        w = _porter_fixup(w)
+    # step 1c
+    if w.endswith("y") and has_vowel(w[:-1]):
+        w = w[:-1] + "i"
+    for suf, rep in (("ational", "ate"), ("tional", "tion"),
+                     ("ization", "ize"), ("fulness", "ful"),
+                     ("ousness", "ous"), ("iveness", "ive"),
+                     ("biliti", "ble"), ("entli", "ent"),
+                     ("ousli", "ous"), ("alli", "al"), ("eli", "e")):
+        if w.endswith(suf) and meas(w[:-len(suf)]) > 0:
+            w = w[:-len(suf)] + rep
+            break
+    return w
+
+
+def _porter_fixup(w: str) -> str:
+    if w.endswith(("at", "bl", "iz")):
+        return w + "e"
+    if (len(w) >= 2 and w[-1] == w[-2]
+            and w[-1] not in "lsz" and w[-1] not in _PORTER_V):
+        return w[:-1]
+    return w
+
+
+def _word_stem(e, batch):
+    a = eval_expr(e.args[0], batch)
+    return _dict_transform(a, _porter_stem, e.type)
+
+
+def _unpack_be(nbytes, signed=True):
+    def f(b: bytes):
+        b = b[:nbytes].rjust(nbytes, b"\x00")
+        return int.from_bytes(b, "big", signed=signed)
+    return f
+
+
+def _unpack_ieee(fmt):
+    import struct
+
+    def f(b: bytes):
+        return struct.unpack(fmt, b[:8 if fmt == ">d" else 4])[0]
+    return f
+
+
+def _pack_fns():
+    import struct
+    return {
+        "to_big_endian_64": lambda v: struct.pack(">q", int(v)),
+        "to_big_endian_32": lambda v: struct.pack(">i", int(v)),
+        "to_ieee754_64": lambda v: struct.pack(">d", float(v)),
+        "to_ieee754_32": lambda v: struct.pack(">f", float(v)),
+    }
+
+
+_DISPATCH_R4 = {
+    "hmac_md5": _hmac("md5"), "hmac_sha1": _hmac("sha1"),
+    "hmac_sha256": _hmac("sha256"), "hmac_sha512": _hmac("sha512"),
+    "to_utf8": _retype_string, "from_utf8": _retype_string,
+    "json_format": _retype_string, "json_parse": _json_parse,
+    "bar": _bar_fn,
+    "color": _retype_string, "render": _retype_string,
+    "parse_datetime": _parse_datetime,
+    "format_datetime": _format_datetime,
+    "from_iso8601_date": _from_iso8601_date,
+    "from_iso8601_timestamp": _from_iso8601_timestamp,
+    "last_day_of_month": _last_day_of_month,
+    "timezone_hour": _timezone_part("hour"),
+    "timezone_minute": _timezone_part("minute"),
+    "word_stem": _word_stem,
+    "from_big_endian_64": _binary_to_num(_unpack_be(8)),
+    "from_big_endian_32": _binary_to_num(_unpack_be(4)),
+    "from_ieee754_64": _binary_to_num(_unpack_ieee(">d")),
+    "from_ieee754_32": _binary_to_num(_unpack_ieee(">f")),
+}
+for _n, _f in _pack_fns().items():
+    _DISPATCH_R4[_n] = _num_to_binary(_f)
+_DISPATCH.update(_DISPATCH_R4)
+
+
+# --- quantile sketch accessors (TDigestFunctions/QuantileDigestFunctions) --
+
+def _digest_lanes(col: Column):
+    starts = np.asarray(col.data).astype(np.int64)
+    lens = (np.zeros_like(starts) if col.data2 is None
+            else np.asarray(col.data2).astype(np.int64))
+    means = np.asarray(col.elements.data).astype(np.float64)
+    weights = np.asarray(col.elements2.data).astype(np.float64)
+    return starts, lens, means, weights
+
+
+def _digest_result(col: Column, vals: np.ndarray, ok: np.ndarray,
+                   out_type):
+    from ..types import QDigestType, is_integral
+    vt = (col.type.value_type
+          if isinstance(col.type, QDigestType) else None)
+    if vt is not None and is_integral(vt):
+        data = np.round(vals).astype(np.int64)
+        return Column(out_type, jnp.asarray(data),
+                      None if ok.all() else jnp.asarray(ok))
+    return Column(out_type, jnp.asarray(vals),
+                  None if ok.all() else jnp.asarray(ok))
+
+
+def _value_at_quantile(e, batch):
+    from ..ops.digest import digest_quantile
+    col = eval_expr(e.args[0], batch)
+    qc = eval_expr(e.args[1], batch)
+    starts, lens, means, weights = _digest_lanes(col)
+    qs = np.asarray(qc.data).astype(np.float64)
+    n = starts.shape[0]
+    out = np.zeros(n, np.float64)
+    ok = np.ones(n, bool)
+    cvalid = None if col.valid is None else np.asarray(col.valid)
+    for i in range(n):
+        if (cvalid is not None and not cvalid[i]) or lens[i] == 0:
+            ok[i] = False
+            continue
+        s, ln = starts[i], lens[i]
+        out[i] = digest_quantile(means[s:s + ln], weights[s:s + ln],
+                                 float(qs[i % qs.shape[0]]))
+    return _digest_result(col, out, ok, e.type)
+
+
+def _values_at_quantiles(e, batch):
+    from ..ops.digest import digest_quantile
+    from ..types import ArrayType
+    col = eval_expr(e.args[0], batch)
+    qarr = eval_expr(e.args[1], batch)
+    starts, lens, means, weights = _digest_lanes(col)
+    qoffs = np.asarray(qarr.data).astype(np.int64)
+    qlens = np.asarray(qarr.data2).astype(np.int64)
+    qvals = np.asarray(qarr.elements.data).astype(np.float64)
+    n = starts.shape[0]
+    cvalid = None if col.valid is None else np.asarray(col.valid)
+    flat = []
+    out_offs = np.zeros(n, np.int64)
+    out_lens = np.zeros(n, np.int64)
+    ok = np.ones(n, bool)
+    for i in range(n):
+        out_offs[i] = len(flat)
+        if (cvalid is not None and not cvalid[i]) or lens[i] == 0:
+            ok[i] = False
+            continue
+        s, ln = starts[i], lens[i]
+        for j in range(int(qoffs[i]), int(qoffs[i] + qlens[i])):
+            flat.append(digest_quantile(means[s:s + ln],
+                                        weights[s:s + ln],
+                                        float(qvals[j])))
+        out_lens[i] = len(flat) - out_offs[i]
+    cap = max(len(flat), 1)
+    fd = np.zeros(cap, np.float64)
+    fd[:len(flat)] = flat
+    elem_t = e.type.element
+    inner = _digest_result(col, fd, np.ones(cap, bool), elem_t)
+    return Column(e.type, jnp.asarray(out_offs),
+                  None if ok.all() else jnp.asarray(ok), None,
+                  jnp.asarray(out_lens), inner)
+
+
+def _quantile_at_value(e, batch):
+    from ..ops.digest import digest_quantile_at_value
+    col = eval_expr(e.args[0], batch)
+    vc = eval_expr(e.args[1], batch)
+    starts, lens, means, weights = _digest_lanes(col)
+    vs = np.asarray(vc.data).astype(np.float64)
+    n = starts.shape[0]
+    out = np.zeros(n, np.float64)
+    ok = np.ones(n, bool)
+    cvalid = None if col.valid is None else np.asarray(col.valid)
+    for i in range(n):
+        if (cvalid is not None and not cvalid[i]) or lens[i] == 0:
+            ok[i] = False
+            continue
+        s, ln = starts[i], lens[i]
+        out[i] = digest_quantile_at_value(
+            means[s:s + ln], weights[s:s + ln],
+            float(vs[i % vs.shape[0]]))
+    return Column(DOUBLE, jnp.asarray(out),
+                  None if ok.all() else jnp.asarray(ok))
+
+
+_DISPATCH.update({
+    "value_at_quantile": _value_at_quantile,
+    "values_at_quantiles": _values_at_quantiles,
+    "quantile_at_value": _quantile_at_value,
+})

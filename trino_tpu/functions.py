@@ -26,6 +26,8 @@ AGGREGATE_NAMES = {
     "corr", "covar_samp", "covar_pop", "regr_slope", "regr_intercept",
     "skewness", "kurtosis", "approx_percentile", "map_agg", "histogram",
     "approx_most_frequent", "approx_set", "merge",
+    "bitwise_and_agg", "bitwise_or_agg", "map_union", "multimap_agg",
+    "numeric_histogram", "tdigest_agg", "qdigest_agg",
 }
 
 WINDOW_ONLY_NAMES = {
@@ -66,18 +68,51 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
         return DOUBLE
     if name == "checksum":
         return BIGINT
+    if name in ("bitwise_and_agg", "bitwise_or_agg"):
+        if not is_integral(t):
+            raise FunctionResolutionError(
+                f"{name}({t}) not supported: argument must be integral")
+        return BIGINT
+    if name == "map_union":
+        from .types import MapType
+        if not isinstance(t, MapType):
+            raise FunctionResolutionError(
+                f"map_union({t}) not supported: argument must be a map")
+        return t
+    if name == "multimap_agg":
+        from .types import ArrayType, MapType
+        return MapType(arg_types[0], ArrayType(arg_types[1]))
+    if name == "numeric_histogram":
+        from .types import MapType
+        return MapType(DOUBLE, DOUBLE)
     if name == "approx_set":
-        from .types import HYPER_LOG_LOG
-        return HYPER_LOG_LOG
-    if name == "merge":
-        # merge() combines sketch values (HLL today; reference also
-        # accepts qdigest/tdigest) — result type follows the input
+        # declared bits match the runtime sketch (ops/hll.py
+        # APPROX_SET_BUCKET_BITS); an explicit max-error argument
+        # re-types the aggregate at plan time (planner/logical.py)
         from .types import HyperLogLogType
-        if not isinstance(t, HyperLogLogType):
+        return HyperLogLogType(12)
+    if name == "merge":
+        # merge() combines sketch values — result type follows the
+        # input (HLL, tdigest or qdigest, like the reference)
+        from .types import HyperLogLogType, QDigestType, TDigestType
+        if not isinstance(t, (HyperLogLogType, TDigestType,
+                              QDigestType)):
             raise FunctionResolutionError(
                 f"merge({t}) not supported: argument must be a "
-                "HyperLogLog sketch")
+                "HyperLogLog / tdigest / qdigest sketch")
         return t
+    if name == "tdigest_agg":
+        from .types import T_DIGEST
+        if not is_numeric(t):
+            raise FunctionResolutionError(
+                f"tdigest_agg({t}) not supported")
+        return T_DIGEST
+    if name == "qdigest_agg":
+        from .types import QDigestType
+        if not is_numeric(t):
+            raise FunctionResolutionError(
+                f"qdigest_agg({t}) not supported")
+        return QDigestType(t)
     if name == "array_agg":
         from .types import ArrayType
         return ArrayType(t)
@@ -131,6 +166,66 @@ def _varchar_fn(name, args):
 
 def _bigint_fn(name, args):
     return BIGINT
+
+
+def _varbinary_fn(name, args):
+    from .types import VARBINARY
+    return VARBINARY
+
+
+def _double_fn_maps(name, args):
+    from .types import MapType
+    for t in args:
+        if not isinstance(t, MapType):
+            raise FunctionResolutionError(
+                f"{name} requires map(varchar, double) arguments")
+    return DOUBLE
+
+
+def _zip_type(name, args):
+    from .types import ArrayType, RowType
+    for t in args:
+        if not isinstance(t, ArrayType):
+            raise FunctionResolutionError(f"{name} requires arrays")
+    return ArrayType(RowType(
+        [(f"field{i}", t.element) for i, t in enumerate(args)]))
+
+
+def _map_from_entries_type(name, args):
+    from .types import ArrayType, MapType, RowType
+    if (not args or not isinstance(args[0], ArrayType)
+            or not isinstance(args[0].element, RowType)
+            or len(args[0].element.fields) != 2):
+        raise FunctionResolutionError(
+            f"{name} requires array(row(K, V))")
+    f = args[0].element.fields
+    return MapType(f[0][1], f[1][1])
+
+
+def _multimap_from_entries_type(name, args):
+    from .types import ArrayType, MapType
+    m = _map_from_entries_type(name, args)
+    return MapType(m.key, ArrayType(m.value))
+
+
+def _split_to_multimap_type():
+    from .types import ArrayType, MapType
+    return MapType(VARCHAR, ArrayType(VARCHAR))
+
+
+def _value_at_quantile_type(name, args):
+    from .types import QDigestType, TDigestType
+    if not args or not isinstance(args[0], (TDigestType, QDigestType)):
+        raise FunctionResolutionError(
+            f"{name} requires a tdigest/qdigest argument")
+    if isinstance(args[0], QDigestType):
+        return args[0].value_type
+    return DOUBLE
+
+
+def _double_fn_sketch(name, args):
+    _value_at_quantile_type(name, args)
+    return DOUBLE
 
 
 _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
@@ -277,12 +372,60 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "json_extract": _varchar_fn,
     "json_array_length": _bigint_fn,
     "json_size": _bigint_fn,
+    "json_format": _varchar_fn,
+    "json_parse": _varchar_fn,
+    # HMAC + binary (HmacFunctions.java / VarbinaryFunctions.java;
+    # varbinary is carried as a dictionary column like varchar)
+    "hmac_md5": _varbinary_fn, "hmac_sha1": _varbinary_fn,
+    "hmac_sha256": _varbinary_fn, "hmac_sha512": _varbinary_fn,
+    "to_utf8": _varbinary_fn,
+    "from_utf8": _varchar_fn,
+    "to_big_endian_64": _varbinary_fn,
+    "from_big_endian_64": _bigint_fn,
+    "to_big_endian_32": _varbinary_fn,
+    "from_big_endian_32": lambda n, a: INTEGER,
+    "to_ieee754_64": _varbinary_fn,
+    "from_ieee754_64": lambda n, a: DOUBLE,
+    "to_ieee754_32": _varbinary_fn,
+    "from_ieee754_32": lambda n, a: REAL,
+    # ANSI bar charts (ColorFunctions.java; color type folded to varchar)
+    "bar": _varchar_fn,
+    "color": _varchar_fn,
+    "render": _varchar_fn,
+    # datetime extras (DateTimeFunctions.java joda-pattern entry points)
+    "parse_datetime": lambda n, a: _tstz([TimestampType(3)]),
+    "format_datetime": _varchar_fn,
+    "from_iso8601_date": lambda n, a: DATE,
+    "from_iso8601_timestamp": lambda n, a: _tstz([TimestampType(3)]),
+    "last_day_of_month": lambda n, a: DATE,
+    "timezone_hour": _bigint_fn,
+    "timezone_minute": _bigint_fn,
+    # similarity (ArrayFunctions / MathFunctions)
+    "cosine_similarity": _double_fn_maps,
+    "word_stem": _varchar_fn,
+    # array extras
+    "array_remove": lambda n, a: _array_of(n, a),
+    "zip": _zip_type,
+    "ngrams": lambda n, a: _mk_array(_array_of(n, a)),
+    "combinations": lambda n, a: _mk_array(_array_of(n, a)),
+    "array_last": lambda n, a: _array_of(n, a).element,
+    "array_first": lambda n, a: _array_of(n, a).element,
+    "map_from_entries": _map_from_entries_type,
+    "multimap_from_entries": _multimap_from_entries_type,
+    "split_to_multimap": lambda n, a: _split_to_multimap_type(),
+    # quantile sketch accessors (TDigestFunctions/QuantileDigestFunctions)
+    "value_at_quantile": _value_at_quantile_type,
+    "values_at_quantiles": lambda n, a: _mk_array(
+        _value_at_quantile_type(n, a)),
+    "quantile_at_value": _double_fn_sketch,
 }
 
 
 def _hll_type():
-    from .types import HYPER_LOG_LOG
-    return HYPER_LOG_LOG
+    # matches approx_set's default bucket count so empty_approx_set()
+    # merges with approx_set(x) sketches (APPROX_SET_BUCKET_BITS)
+    from .types import HyperLogLogType
+    return HyperLogLogType(12)
 
 
 def _array_elem(name, args):

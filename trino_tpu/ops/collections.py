@@ -1,0 +1,233 @@
+"""Host-side collection aggregates: map_union, multimap_agg,
+numeric_histogram.
+
+Reference parity: operator/aggregation/MapUnionAggregation.java,
+MultimapAggregationFunction.java, NumericHistogramAggregation.java +
+NumericHistogram.java.
+
+These aggregates build per-group variable-length nested structures whose
+entry counts are data-dependent twice over (rows per group x entries per
+row) — the capacity-planning cost of keeping them on device exceeds the
+win, and like merge(hll) they typically consume small pre-aggregated
+batches. They run on host numpy over fetched lanes (the hll_merge
+pattern, ops/groupby.py); the chain-JIT executes aggregation nodes
+eagerly so the host round-trip is legal.
+
+Entry selection is done by INDEX into the flat element pools, then the
+output pools are built with Column.gather — so nested element types
+(dictionary strings, decimals, arrays) ride along without per-type host
+code.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column
+from ..config import capacity_for
+from ..types import DOUBLE, MapType, ArrayType
+
+__all__ = ["rows_by_group", "grouped_map_union", "grouped_multimap_agg",
+           "grouped_numeric_histogram"]
+
+
+def rows_by_group(order, gid, valid_s, gcap: int) -> List[np.ndarray]:
+    """Original-row indices per group, in group-sorted row order.
+    ``order``/``gid``/``valid_s`` are the group-sorted lanes of
+    ops/groupby.py (valid_s = live & input-valid & FILTER mask)."""
+    order = np.asarray(jax.device_get(order))
+    gid = np.asarray(jax.device_get(gid))
+    valid_s = np.asarray(jax.device_get(valid_s))
+    groups: List[List[int]] = [[] for _ in range(gcap)]
+    for pos in range(order.shape[0]):
+        if valid_s[pos]:
+            g = int(gid[pos])
+            if 0 <= g < gcap:
+                groups[g].append(int(order[pos]))
+    return [np.asarray(g, dtype=np.int64) for g in groups]
+
+
+def _entry_key_fn(col: Column):
+    """Host equality key for one flat pool position."""
+    data = np.asarray(jax.device_get(col.data))
+    data2 = (None if col.data2 is None
+             else np.asarray(jax.device_get(col.data2)))
+    valid = (None if col.valid is None
+             else np.asarray(jax.device_get(col.valid)))
+
+    def key(j: int):
+        if valid is not None and not valid[j]:
+            return (False, 0, 0)
+        d2 = 0 if data2 is None else data2[j].item()
+        return (True, data[j].item(), d2)
+    return key
+
+
+def _pool_gather(elem: Column, idx: np.ndarray) -> Column:
+    cap = capacity_for(max(int(idx.shape[0]), 1))
+    padded = np.zeros(cap, dtype=np.int64)
+    padded[:idx.shape[0]] = idx
+    return elem.gather(jnp.asarray(padded))
+
+
+def grouped_map_union(col: Column, groups: List[np.ndarray],
+                      group_valid) -> Column:
+    """Per-group union of map entries; first occurrence of a key wins
+    (reference MapUnionAggregation keeps the first seen value)."""
+    starts = np.asarray(jax.device_get(col.data))
+    lens = np.asarray(jax.device_get(col.data2))
+    keyf = _entry_key_fn(col.elements)
+
+    sel: List[int] = []
+    out_start = np.zeros(len(groups), dtype=np.int64)
+    out_len = np.zeros(len(groups), dtype=np.int64)
+    for g, rows in enumerate(groups):
+        out_start[g] = len(sel)
+        seen = set()
+        for r in rows:
+            s, ln = int(starts[r]), int(lens[r])
+            for j in range(s, s + ln):
+                k = keyf(j)
+                if k not in seen:
+                    seen.add(k)
+                    sel.append(j)
+        out_len[g] = len(sel) - out_start[g]
+
+    idx = np.asarray(sel, dtype=np.int64)
+    return Column(col.type, jnp.asarray(out_start), group_valid, None,
+                  jnp.asarray(out_len),
+                  _pool_gather(col.elements, idx),
+                  _pool_gather(col.elements2, idx))
+
+
+def grouped_multimap_agg(kcol: Column, vcol: Column,
+                         groups: List[np.ndarray], group_valid) -> Column:
+    """multimap_agg(k, v) -> map(K, array(V)): per group, distinct keys
+    in first-seen order, each mapped to the array of its values in row
+    order (reference MultimapAggregationFunction; NULL values are
+    collected, rows with NULL keys too — a NULL key is a key)."""
+    keyf = _entry_key_fn(kcol)
+
+    key_rows: List[int] = []      # one representative row per (g, key)
+    val_rows: List[int] = []      # value pool rows, grouped by (g, key)
+    arr_start: List[int] = []
+    arr_len: List[int] = []
+    out_start = np.zeros(len(groups), dtype=np.int64)
+    out_len = np.zeros(len(groups), dtype=np.int64)
+    for g, rows in enumerate(groups):
+        out_start[g] = len(key_rows)
+        order_keys: List[Tuple] = []
+        per_key = {}
+        for r in rows:
+            k = keyf(int(r))
+            if k not in per_key:
+                per_key[k] = (int(r), [])
+                order_keys.append(k)
+            per_key[k][1].append(int(r))
+        for k in order_keys:
+            rep, vals = per_key[k]
+            key_rows.append(rep)
+            arr_start.append(len(val_rows))
+            arr_len.append(len(vals))
+            val_rows.extend(vals)
+        out_len[g] = len(key_rows) - out_start[g]
+
+    ecap = capacity_for(max(len(key_rows), 1))
+    astart = np.zeros(ecap, dtype=np.int64)
+    alen = np.zeros(ecap, dtype=np.int64)
+    astart[:len(arr_start)] = arr_start
+    alen[:len(arr_len)] = arr_len
+    varr = Column(ArrayType(vcol.type), jnp.asarray(astart), None, None,
+                  jnp.asarray(alen),
+                  _pool_gather(vcol, np.asarray(val_rows, np.int64)))
+    return Column(MapType(kcol.type, ArrayType(vcol.type)),
+                  jnp.asarray(out_start), group_valid, None,
+                  jnp.asarray(out_len),
+                  _pool_gather(kcol, np.asarray(key_rows, np.int64)),
+                  varr)
+
+
+def _merge_histogram(values: np.ndarray, buckets: int,
+                     weights: Optional[np.ndarray] = None):
+    """Greedy adjacent-merge of sorted (x, w) pairs until <= buckets —
+    the same centroid-merging idea as the reference's NumericHistogram
+    (it merges the two closest buckets on overflow)."""
+    if values.size == 0:
+        return [], []
+    if weights is None:
+        xs, ws = np.unique(values, return_counts=True)
+        ws = ws.astype(np.float64)
+    else:
+        xs, inv = np.unique(values, return_inverse=True)
+        ws = np.zeros(xs.size, np.float64)
+        np.add.at(ws, inv, weights.astype(np.float64))
+    xs = xs.astype(np.float64)
+    n = xs.size
+    if n <= buckets:
+        return list(xs), list(ws)
+    # doubly-linked list + heap of adjacent gaps
+    prev = list(range(-1, n - 1))
+    nxt = list(range(1, n + 1))
+    alive = [True] * n
+    x = list(xs)
+    w = list(ws)
+    heap = [(x[i + 1] - x[i], i, i + 1) for i in range(n - 1)]
+    heapq.heapify(heap)
+    remaining = n
+    while remaining > buckets and heap:
+        _, i, j = heapq.heappop(heap)
+        if not (alive[i] and alive[j]) or nxt[i] != j:
+            continue
+        tot = w[i] + w[j]
+        x[i] = (x[i] * w[i] + x[j] * w[j]) / tot
+        w[i] = tot
+        alive[j] = False
+        nxt[i] = nxt[j]
+        if nxt[i] < n:
+            prev[nxt[i]] = i
+            heapq.heappush(heap, (x[nxt[i]] - x[i], i, nxt[i]))
+        if prev[i] >= 0:
+            heapq.heappush(heap, (x[i] - x[prev[i]], prev[i], i))
+        remaining -= 1
+    keep = [i for i in range(n) if alive[i]]
+    return [x[i] for i in keep], [w[i] for i in keep]
+
+
+def grouped_numeric_histogram(col: Column, groups: List[np.ndarray],
+                              group_valid, buckets: int,
+                              scale: Optional[float] = None,
+                              weight_col: Optional[Column] = None
+                              ) -> Column:
+    """numeric_histogram(buckets, v[, w]) -> map(double, double)."""
+    data = np.asarray(jax.device_get(col.data)).astype(np.float64)
+    if scale:
+        data = data / scale
+    wl = (None if weight_col is None
+          else np.asarray(jax.device_get(weight_col.data))
+          .astype(np.float64))
+    keys: List[float] = []
+    wts: List[float] = []
+    out_start = np.zeros(len(groups), dtype=np.int64)
+    out_len = np.zeros(len(groups), dtype=np.int64)
+    for g, rows in enumerate(groups):
+        out_start[g] = len(keys)
+        xs, ws = _merge_histogram(data[rows], buckets,
+                                  None if wl is None else wl[rows])
+        keys.extend(xs)
+        wts.extend(ws)
+        out_len[g] = len(keys) - out_start[g]
+
+    ecap = capacity_for(max(len(keys), 1))
+    kd = np.zeros(ecap, dtype=np.float64)
+    vd = np.zeros(ecap, dtype=np.float64)
+    kd[:len(keys)] = keys
+    vd[:len(wts)] = wts
+    return Column(MapType(DOUBLE, DOUBLE), jnp.asarray(out_start),
+                  group_valid, None, jnp.asarray(out_len),
+                  Column(DOUBLE, jnp.asarray(kd)),
+                  Column(DOUBLE, jnp.asarray(vd)))

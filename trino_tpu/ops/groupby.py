@@ -47,7 +47,8 @@ class AggInput:
 # count_distinct, percentile) need all rows of a group co-located, i.e.
 # repartition-BEFORE-aggregate
 COMBINABLE_KINDS = {"sum": "sum", "count": "sum", "count_star": "sum",
-                    "min": "min", "max": "max", "any_value": "any_value"}
+                    "min": "min", "max": "max", "any_value": "any_value",
+                    "bit_and": "bit_and", "bit_or": "bit_or"}
 
 
 def _key_lanes(batch: Batch, key_names: Sequence[str],
@@ -109,7 +110,8 @@ def _identity_for(kind: str, dtype) -> jax.Array:
 # take on; beyond this the lexsort path wins (graph size / compile time)
 FAST_DOMAIN_LIMIT = 64
 
-_FAST_KINDS = {"sum", "count", "count_star", "min", "max", "any_value"}
+_FAST_KINDS = {"sum", "count", "count_star", "min", "max", "any_value",
+               "bit_and", "bit_or"}
 
 
 def _static_domain(col: Column) -> Optional[int]:
@@ -329,6 +331,15 @@ def _masked_agg(batch: Batch, agg: AggInput, gmasks, live,
             [jnp.sum(jnp.where(g, av, zero)) for g in gmasks])
         return Column(_sum_type(col.type), data, group_valid)
 
+    if agg.kind in ("bit_and", "bit_or"):
+        op = jnp.bitwise_and if agg.kind == "bit_and" else jnp.bitwise_or
+        ident = jnp.asarray(-1 if agg.kind == "bit_and" else 0, jnp.int64)
+        work = vals.astype(jnp.int64)
+        data = jnp.stack(
+            [jax.lax.reduce(jnp.where(g, work, ident), ident,
+                            op, (0,)) for g in gmasks])
+        return Column(BIGINT, data, group_valid)
+
     if agg.kind in ("min", "max"):
         red = jnp.min if agg.kind == "min" else jnp.max
         if is_string(col.type):
@@ -469,6 +480,29 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         data = jax.ops.segment_sum(masked, gid, num_segments=gcap)
         return Column(_sum_type(col.type), data, group_valid)
 
+    if agg.kind in ("bit_and", "bit_or"):
+        # segmented associative scan over the group-sorted rows (AND/OR
+        # have no jax.ops.segment_* primitive; they are associative and
+        # commutative, so a (gid, value) scan + last-of-segment gather is
+        # exact — reference: BitwiseAndAggregation/BitwiseOrAggregation)
+        op = jnp.bitwise_and if agg.kind == "bit_and" else jnp.bitwise_or
+        ident = jnp.asarray(-1 if agg.kind == "bit_and" else 0, jnp.int64)
+        work = jnp.where(valid, vals.astype(jnp.int64), ident)
+        gid64 = gid.astype(jnp.int64)
+
+        def _comb(a, b):
+            ga, va = a
+            gb, vb = b
+            return gb, jnp.where(ga == gb, op(va, vb), vb)
+
+        _, scanned = jax.lax.associative_scan(_comb, (gid64, work))
+        cap = order.shape[0]
+        pos = jnp.arange(cap, dtype=jnp.int64)
+        last = jax.ops.segment_max(
+            jnp.where(live_s, pos, jnp.int64(-1)), gid, num_segments=gcap)
+        data = jnp.take(scanned, jnp.clip(last, 0, cap - 1))
+        return Column(BIGINT, data, group_valid)
+
     if agg.kind in ("min", "max"):
         seg = jax.ops.segment_min if agg.kind == "min" else \
             jax.ops.segment_max
@@ -607,7 +641,55 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         return _resorted_agg(batch, agg, col, gid, live_s, gcap,
                              key_lanes, extra_mask, order, live_u)
 
+    if agg.kind in ("map_union", "multimap_agg", "numeric_histogram"):
+        # host-side collection aggregates (hll_merge pattern; see
+        # ops/collections.py module docstring for the rationale)
+        from .collections import (grouped_map_union, grouped_multimap_agg,
+                                  grouped_numeric_histogram, rows_by_group)
+        groups = rows_by_group(order, gid, valid, gcap)
+        if agg.kind == "map_union":
+            return grouped_map_union(col, groups, group_valid)
+        if agg.kind == "multimap_agg":
+            return grouped_multimap_agg(col, batch.column(agg.input2),
+                                        groups, group_valid)
+        from ..types import DecimalType as _Dec
+        scale = (10.0 ** col.type.scale
+                 if isinstance(col.type, _Dec) else None)
+        wcol = batch.column(agg.input2) if agg.input2 else None
+        return grouped_numeric_histogram(col, groups, group_valid,
+                                         int(agg.param or 2), scale,
+                                         wcol)
+
+    if agg.kind in ("tdigest", "qdigest", "digest_merge"):
+        from .collections import rows_by_group
+        from .digest import (DEFAULT_COMPRESSION, DEFAULT_QDIGEST_BUDGET,
+                             grouped_digest, grouped_digest_merge)
+        groups = rows_by_group(order, gid, valid, gcap)
+        if agg.kind == "digest_merge":
+            return grouped_digest_merge(col, groups, group_valid,
+                                        DEFAULT_COMPRESSION)
+        return _grouped_digest_build(batch, agg, col, groups,
+                                     group_valid)
+
     raise ValueError(f"unknown aggregate kind {agg.kind}")
+
+
+def _grouped_digest_build(batch: Batch, agg: AggInput, col: Column,
+                          groups, group_valid) -> Column:
+    from ..types import (DecimalType as _Dec, QDigestType, T_DIGEST)
+    from .digest import (DEFAULT_COMPRESSION, DEFAULT_QDIGEST_BUDGET,
+                         grouped_digest)
+    wcol = (batch.column(agg.input2)
+            if getattr(agg, "input2", None) else None)
+    scale = (10.0 ** col.type.scale
+             if isinstance(col.type, _Dec) else None)
+    if agg.kind == "tdigest":
+        return grouped_digest(col, groups, group_valid, T_DIGEST,
+                              DEFAULT_COMPRESSION, wcol, scale)
+    budget = (int(2.0 / float(agg.param))
+              if agg.param else DEFAULT_QDIGEST_BUDGET)
+    return grouped_digest(col, groups, group_valid,
+                          QDigestType(col.type), budget, wcol, scale)
 
 
 def _isnan(x: jax.Array) -> jax.Array:
@@ -833,6 +915,14 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             from dataclasses import replace as _replace
             idx = jnp.argmax(valid)  # first valid row (0 if none)
             out[agg.output] = _replace(col.gather(idx[None]), valid=has)
+        elif agg.kind in ("bit_and", "bit_or"):
+            op = (jnp.bitwise_and if agg.kind == "bit_and"
+                  else jnp.bitwise_or)
+            ident = jnp.asarray(-1 if agg.kind == "bit_and" else 0,
+                                jnp.int64)
+            masked = jnp.where(valid, vals.astype(jnp.int64), ident)
+            r = jax.lax.reduce(masked, ident, op, (0,))[None]
+            out[agg.output] = Column(BIGINT, r, has)
         elif agg.kind in ("argmin", "argmax"):
             from dataclasses import replace as _replace
             comp = batch.column(agg.input2)
@@ -915,6 +1005,43 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             out[agg.output] = Column(
                 out_t, jnp.zeros((1,), jnp.int64), (nent > 0)[None],
                 None, nent[None], keys_pool, vals_pool)
+        elif agg.kind in ("map_union", "multimap_agg",
+                          "numeric_histogram"):
+            from .collections import (grouped_map_union,
+                                      grouped_multimap_agg,
+                                      grouped_numeric_histogram,
+                                      rows_by_group)
+            cap = batch.capacity
+            ident = jnp.arange(cap, dtype=jnp.int64)
+            gid0 = jnp.zeros((cap,), jnp.int32)
+            groups = rows_by_group(ident, gid0, valid, 1)
+            if agg.kind == "map_union":
+                out[agg.output] = grouped_map_union(col, groups, has)
+            elif agg.kind == "multimap_agg":
+                out[agg.output] = grouped_multimap_agg(
+                    col, batch.column(agg.input2), groups, has)
+            else:
+                from ..types import DecimalType as _Dec
+                scale = (10.0 ** col.type.scale
+                         if isinstance(col.type, _Dec) else None)
+                wcol = (batch.column(agg.input2) if agg.input2
+                        else None)
+                out[agg.output] = grouped_numeric_histogram(
+                    col, groups, has, int(agg.param or 2), scale, wcol)
+        elif agg.kind in ("tdigest", "qdigest", "digest_merge"):
+            from .collections import rows_by_group
+            from .digest import (DEFAULT_COMPRESSION,
+                                 grouped_digest_merge)
+            cap = batch.capacity
+            ident = jnp.arange(cap, dtype=jnp.int64)
+            gid0 = jnp.zeros((cap,), jnp.int32)
+            groups = rows_by_group(ident, gid0, valid, 1)
+            if agg.kind == "digest_merge":
+                out[agg.output] = grouped_digest_merge(
+                    col, groups, has, DEFAULT_COMPRESSION)
+            else:
+                out[agg.output] = _grouped_digest_build(
+                    batch, agg, col, groups, has)
         elif agg.kind == "hll":
             from ..types import HyperLogLogType, INTEGER as _INT
             from .hll import DEFAULT_BUCKET_BITS, grouped_sparse_hll

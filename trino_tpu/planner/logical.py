@@ -46,6 +46,11 @@ from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, UNKNOWN,
                      is_numeric, is_string, parse_type)
 
 
+class _NonConstValues(Exception):
+    """Internal: a VALUES entry didn't constant-fold (triggers the
+    UNION-ALL-of-SELECTs fallback in _plan_values)."""
+
+
 class PlanningError(Exception):
     """SemanticException analog (error codes in Appendix A.8 taxonomy)."""
 
@@ -165,7 +170,30 @@ class LogicalPlanner:
         raise PlanningError(f"unsupported query body {type(body).__name__}")
 
     def _plan_values(self, rows) -> RelationPlan:
-        # evaluate constant expressions host-side
+        # evaluate constant expressions host-side; rows with
+        # non-constant entries (map(...)/ARRAY[x]/scalar calls — the
+        # reference allows arbitrary expressions in VALUES,
+        # sql/planner/QueryPlanner.planValues) fall back to a UNION ALL
+        # of single-row SELECTs
+        try:
+            return self._plan_values_const(rows)
+        except _NonConstValues:
+            # balanced UNION ALL tree (a left-deep chain would recurse
+            # once per row and overflow on long VALUES lists)
+            parts: List[A.QueryBody] = [
+                A.QuerySpecification(select_items=tuple(
+                    A.SelectItem(e, f"_col{i}")
+                    for i, e in enumerate(row)))
+                for row in rows]
+            while len(parts) > 1:
+                parts = [A.SetOperation("union", False, parts[i],
+                                        parts[i + 1])
+                         if i + 1 < len(parts) else parts[i]
+                         for i in range(0, len(parts), 2)]
+            rp, _ = self._plan_body(parts[0], None)
+            return rp
+
+    def _plan_values_const(self, rows) -> RelationPlan:
         n_cols = len(rows[0])
         values: List[List[object]] = []
         types: List[Type] = [UNKNOWN] * n_cols
@@ -194,7 +222,7 @@ class LogicalPlanner:
         ex = self._rewrite_expr(e, _ExprContext(self, Scope([]), None))
         folded = _const_fold(ex)
         if not isinstance(folded, Const):
-            raise PlanningError("VALUES entries must be constant")
+            raise _NonConstValues("VALUES entries must be constant")
         return folded
 
     def _plan_setop(self, body: A.SetOperation, outer):
@@ -462,6 +490,35 @@ class LogicalPlanner:
             param = None
             if call.name == "count" and (star or not args):
                 kind, arg_sym, rtype = "count_star", None, BIGINT
+            elif call.name == "numeric_histogram":
+                # numeric_histogram(buckets, value[, weight]): buckets
+                # is a constant; value and weight are lanes
+                kind = call.name
+                if len(args) < 2 or len(args) > 3 \
+                        or not isinstance(args[0], Const) \
+                        or args[0].value is None:
+                    raise PlanningError(
+                        "numeric_histogram(buckets, value[, weight]): "
+                        "buckets must be a constant")
+                param = float(args[0].value)
+                if param < 2:
+                    raise PlanningError(
+                        "numeric_histogram: buckets must be >= 2")
+                from ..types import MapType
+                rtype = MapType(DOUBLE, DOUBLE)
+                a1 = args[1]
+                if isinstance(a1, InputRef):
+                    arg_sym = a1.name
+                else:
+                    arg_sym = self.symbols.new(f"{kind}_arg")
+                    pre_assigns[arg_sym] = a1
+                if len(args) == 3:
+                    a2 = args[2]
+                    if isinstance(a2, InputRef):
+                        arg2_sym = a2.name
+                    else:
+                        arg2_sym = self.symbols.new(f"{kind}_arg2")
+                        pre_assigns[arg2_sym] = a2
             elif call.name == "approx_most_frequent":
                 # approx_most_frequent(buckets, value[, capacity]):
                 # buckets/capacity are constants, value is the lane
@@ -514,16 +571,21 @@ class LogicalPlanner:
                         param = float(a1.value)
                         if kind == "approx_set":
                             # validate eagerly (plan-time error beats a
-                            # kernel-trace error)
+                            # kernel-trace error) and re-type so the
+                            # declared bucket bits match the runtime
+                            # sketch
                             from ..ops.hll import bucket_bits_for_error
+                            from ..types import HyperLogLogType
                             try:
-                                bucket_bits_for_error(param)
+                                rtype = HyperLogLogType(
+                                    bucket_bits_for_error(param))
                             except ValueError as ex:
                                 raise PlanningError(str(ex))
                     elif kind in ("min_by", "max_by", "corr",
                                   "covar_samp", "covar_pop",
                                   "regr_slope", "regr_intercept",
-                                  "map_agg"):
+                                  "map_agg", "multimap_agg",
+                                  "tdigest_agg", "qdigest_agg"):
                         a1 = args[1]
                         if isinstance(a1, InputRef):
                             arg2_sym = a1.name
@@ -535,8 +597,14 @@ class LogicalPlanner:
                             f"{kind}: multi-argument aggregates not yet "
                             "supported")
                     if len(args) > 2:
-                        raise PlanningError(
-                            f"{kind}: too many arguments")
+                        if kind == "qdigest_agg" and len(args) == 3 \
+                                and isinstance(args[2], Const) \
+                                and args[2].value is not None:
+                            # qdigest_agg(x, w, accuracy)
+                            param = float(args[2].value)
+                        else:
+                            raise PlanningError(
+                                f"{kind}: too many arguments")
             out_sym = self.symbols.new(call.name)
             aggregates[out_sym] = Aggregate(kind, arg_sym, rtype,
                                             call.distinct, mask_sym,

@@ -1,0 +1,115 @@
+"""Plugin SPI + loader: dynamic connector/function registration.
+
+Reference parity: core/trino-spi/.../Plugin.java:35-90 (a plugin
+contributes connector factories, types, functions, access controls,
+event listeners) + server/PluginManager.java (discovers plugin dirs and
+registers every SPI surface). Python redesign: a plugin is an importable
+module exposing ``get_connector_factories()`` (and optionally
+``get_functions()`` / ``get_event_listeners()``); isolation comes from
+the module system rather than per-plugin classloaders.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Callable, Dict, List, Optional
+
+
+class ConnectorFactory:
+    """spi/connector/ConnectorFactory: name + create(catalog, props)."""
+
+    def __init__(self, name: str, create: Callable):
+        self.name = name
+        self._create = create
+
+    def create(self, catalog_name: str, props: Dict[str, str]):
+        return self._create(catalog_name, props)
+
+
+_FACTORIES: Dict[str, ConnectorFactory] = {}
+
+
+def register_factory(factory: ConnectorFactory) -> None:
+    _FACTORIES[factory.name] = factory
+
+
+def connector_factories() -> List[str]:
+    _ensure_builtins()
+    return sorted(_FACTORIES)
+
+
+def load_plugin(module_path: str) -> List[str]:
+    """Import a plugin module and register its factories
+    (PluginManager.installPlugin). Returns the factory names added."""
+    mod = importlib.import_module(module_path)
+    added = []
+    get = getattr(mod, "get_connector_factories", None)
+    if get is None:
+        raise ValueError(
+            f"plugin module {module_path!r} has no "
+            "get_connector_factories()")
+    for f in get():
+        if not isinstance(f, ConnectorFactory):
+            name, create = f  # (name, callable) tuple form
+            f = ConnectorFactory(name, create)
+        register_factory(f)
+        added.append(f.name)
+    for reg in getattr(mod, "get_functions", lambda: [])():
+        # (name, typing_fn, eval_fn): contribute a scalar builtin
+        fname, typing_fn, eval_fn = reg
+        from . import functions as _fns
+        from .exec import expr as _expr
+        _fns._SCALARS[fname] = typing_fn
+        _expr._DISPATCH[fname] = eval_fn
+        added.append(fname)
+    return added
+
+
+def create_connector(kind: str, catalog_name: str,
+                     props: Optional[Dict[str, str]] = None):
+    """connector.name -> Connector instance; ``kind`` may also be a
+    'module.path:factory_name' reference, loaded on demand."""
+    _ensure_builtins()
+    props = props or {}
+    if kind not in _FACTORIES and ":" in kind:
+        module_path, _, fname = kind.partition(":")
+        load_plugin(module_path)
+        kind = fname
+    f = _FACTORIES.get(kind)
+    if f is None:
+        raise KeyError(
+            f"unknown connector.name '{kind}' (available: "
+            f"{', '.join(sorted(_FACTORIES))})")
+    return f.create(catalog_name, props)
+
+
+_BUILTINS_DONE = False
+
+
+def _ensure_builtins() -> None:
+    global _BUILTINS_DONE
+    if _BUILTINS_DONE:
+        return
+    _BUILTINS_DONE = True
+    from .connectors.memory import BlackholeConnector, MemoryConnector
+    from .connectors.system import SystemConnector
+    from .connectors.tpcds import TpcdsConnector
+    from .connectors.tpch import TpchConnector
+
+    register_factory(ConnectorFactory(
+        "tpch", lambda n, p: TpchConnector(
+            rows_per_split=int(p["tpch.rows-per-split"]))
+        if "tpch.rows-per-split" in p else TpchConnector()))
+    register_factory(ConnectorFactory(
+        "tpcds", lambda n, p: TpcdsConnector()))
+    register_factory(ConnectorFactory(
+        "memory", lambda n, p: MemoryConnector()))
+    register_factory(ConnectorFactory(
+        "blackhole", lambda n, p: BlackholeConnector()))
+    register_factory(ConnectorFactory(
+        "system", lambda n, p: SystemConnector()))
+
+    def _localfile(n, p):
+        from .connectors.localfile import LocalFileConnector
+        return LocalFileConnector(p.get("localfile.root", "."))
+    register_factory(ConnectorFactory("localfile", _localfile))

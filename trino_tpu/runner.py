@@ -285,6 +285,10 @@ class LocalQueryRunner:
         if isinstance(stmt, A.ShowFunctions):
             return QueryResult(["Function"], [VARCHAR],
                                [[f] for f in list_functions()])
+        if isinstance(stmt, (A.Grant, A.Revoke, A.Deny)):
+            return self._grant_revoke(stmt)
+        if isinstance(stmt, A.ShowGrants):
+            return self._show_grants(stmt)
         if isinstance(stmt, A.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, A.DropTable):
@@ -360,6 +364,63 @@ class LocalQueryRunner:
         except KeyError as e:
             raise QueryError(str(e).strip('"')) from e
         return _msg_result("CREATE VIEW")
+
+    def _resolve_table(self, parts) -> Tuple[str, str, str]:
+        cat, schema, name = self._qualify(parts)
+        conn = self.catalogs.connector(cat)
+        if conn.get_table_metadata(schema, name) is None \
+                and self.catalogs.get_view(cat, schema, name) is None:
+            raise QueryError(
+                f"Table '{cat}.{schema}.{name}' does not exist")
+        return cat, schema, name
+
+    def _grant_revoke(self, stmt) -> QueryResult:
+        """GRANT / REVOKE / DENY on an engine-level grant store
+        (reference: execution/{GrantTask,RevokeTask,DenyTask}.java; the
+        reference routes to connector metadata, ours is engine-scoped
+        so every connector supports grants)."""
+        cat, schema, name = self._resolve_table(stmt.table)
+        store = self.catalogs.grants
+        if isinstance(stmt, A.Grant):
+            for p in stmt.privileges:
+                key = (stmt.grantee, p, cat, schema, name)
+                store[key] = stmt.grant_option or store.get(key, False)
+            return _msg_result("GRANT")
+        if isinstance(stmt, A.Deny):
+            for p in stmt.privileges:
+                self.catalogs.denies.add(
+                    (stmt.grantee, p, cat, schema, name))
+            return _msg_result("DENY")
+        for p in stmt.privileges:
+            key = (stmt.grantee, p, cat, schema, name)
+            if stmt.grant_option_for:
+                if key in store:
+                    store[key] = False
+            else:
+                store.pop(key, None)
+                self.catalogs.denies.discard(key)
+        return _msg_result("REVOKE")
+
+    def _show_grants(self, stmt: "A.ShowGrants") -> QueryResult:
+        """SHOW GRANTS [ON t] — information_schema.table_privileges
+        shape (reference: ShowQueriesRewrite + TablePrivilegeInfo)."""
+        from .types import BOOLEAN as _B
+        flt = None
+        if stmt.table is not None:
+            flt = self._resolve_table(stmt.table)
+        rows = []
+        for (grantee, p, cat, schema, name), opt in sorted(
+                self.catalogs.grants.items()):
+            if flt is not None and (cat, schema, name) != flt:
+                continue
+            rows.append([self.session.user or "admin", "USER", grantee,
+                         "USER", cat, schema, name, p.upper(), opt,
+                         None])
+        return QueryResult(
+            ["Grantor", "Grantor Type", "Grantee", "Grantee Type",
+             "Catalog", "Schema", "Table", "Privilege", "Grantable",
+             "With Hierarchy"],
+            [VARCHAR] * 8 + [_B, _B], rows)
 
     def _show_stats(self, stmt: "A.ShowStats") -> QueryResult:
         """SHOW STATS FOR table (reference: sql/rewrite/

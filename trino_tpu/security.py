@@ -164,3 +164,53 @@ class RuleBasedAccessControl(AccessControl):
 
     def check_can_delete(self, user, catalog, schema, table):
         self._check("delete", user, catalog, schema, table)
+
+
+class GrantBasedAccessControl(AccessControl):
+    """Consults the engine-level grant store maintained by GRANT/REVOKE/
+    DENY statements (catalog.CatalogManager.grants). Superusers bypass;
+    DENY beats GRANT (reference: connector grant semantics +
+    io.trino.spi.security.Privilege)."""
+
+    def __init__(self, catalogs, superusers=("admin",)):
+        self.catalogs = catalogs
+        self.superusers = set(superusers)
+
+    def _check(self, privilege: str, user: str, catalog: str,
+               schema: str, table: str) -> None:
+        if user in self.superusers:
+            return
+        key = (user, privilege, catalog, schema, table)
+        if key in self.catalogs.denies:
+            raise AccessDeniedError(
+                f"Cannot {privilege} table "
+                f"{catalog}.{schema}.{table} as user {user}")
+        if key in self.catalogs.grants:
+            return
+        raise AccessDeniedError(
+            f"Cannot {privilege} table {catalog}.{schema}.{table} "
+            f"as user {user}")
+
+    def check_can_select(self, user, catalog, schema, table):
+        self._check("select", user, catalog, schema, table)
+
+    def check_can_insert(self, user, catalog, schema, table):
+        self._check("insert", user, catalog, schema, table)
+
+    def check_can_delete(self, user, catalog, schema, table):
+        self._check("delete", user, catalog, schema, table)
+
+    def check_can_update(self, user, catalog, schema, table):
+        self._check("update", user, catalog, schema, table)
+
+    def check_can_create_table(self, user, catalog, schema, table):
+        if user not in self.superusers:
+            raise AccessDeniedError(
+                f"Cannot create table {catalog}.{schema}.{table} "
+                f"as user {user}")
+
+    def check_can_drop_table(self, user, catalog, schema, table):
+        if user not in self.superusers:
+            raise AccessDeniedError(
+                f"Cannot drop table {catalog}.{schema}.{table} "
+                f"as user {user}")

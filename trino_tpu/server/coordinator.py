@@ -85,16 +85,14 @@ class _Query:
         except Exception as e:   # error taxonomy: Appendix A.8
             if self._cancel.is_set() or not self._transition("FAILED"):
                 return
-            name = type(e).__name__
+            from ..errors import classify
+            ename, ecode, etype = classify(e)
             self.error = {
                 "message": str(e),
-                "errorCode": 1,
-                "errorName": ("SYNTAX_ERROR"
-                              if "SYNTAX_ERROR" in str(e)
-                              else "GENERIC_INTERNAL_ERROR"),
-                "errorType": ("USER_ERROR" if name == "QueryError"
-                              else "INTERNAL_ERROR"),
-                "failureInfo": {"type": name,
+                "errorCode": ecode,
+                "errorName": ename,
+                "errorType": etype,
+                "failureInfo": {"type": type(e).__name__,
                                 "stack": traceback.format_exc()
                                 .splitlines()[-5:]},
             }

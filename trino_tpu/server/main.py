@@ -30,16 +30,15 @@ def load_properties(path: str) -> Dict[str, str]:
     return out
 
 
-def build_catalogs(etc_dir: Optional[str]):
-    """etc/catalog/*.properties -> CatalogManager
-    (connector.name selects the plugin, like the reference's catalog
-    property files)."""
+def build_catalogs(etc_dir: Optional[str],
+                   plugins: Optional[list] = None):
+    """etc/catalog/*.properties -> CatalogManager via the plugin
+    registry (connector.name selects the factory — the reference's
+    catalog property files + PluginManager; trino_tpu/plugin.py)."""
+    from .. import plugin
     from ..catalog import CatalogManager
-    from ..connectors.memory import (BlackholeConnector,
-                                     MemoryConnector)
-    from ..connectors.system import SystemConnector
-    from ..connectors.tpcds import TpcdsConnector
-    from ..connectors.tpch import TpchConnector
+    for mod in plugins or []:
+        plugin.load_plugin(mod)
     cat_dir = os.path.join(etc_dir, "catalog") if etc_dir else None
     mgr = CatalogManager()
     made = False
@@ -50,27 +49,16 @@ def build_catalogs(etc_dir: Optional[str]):
             name = fn[:-len(".properties")]
             props = load_properties(os.path.join(cat_dir, fn))
             kind = props.get("connector.name", name)
-            if kind == "tpch":
-                mgr.register(name, TpchConnector())
-            elif kind == "tpcds":
-                mgr.register(name, TpcdsConnector())
-            elif kind == "memory":
-                mgr.register(name, MemoryConnector())
-            elif kind == "blackhole":
-                mgr.register(name, BlackholeConnector())
-            elif kind == "localfile":
-                from ..connectors.localfile import LocalFileConnector
-                mgr.register(name, LocalFileConnector(
-                    props.get("localfile.root", ".")))
-            else:
-                print(f"warning: unknown connector.name={kind} "
-                      f"for catalog {name}", file=sys.stderr)
+            try:
+                mgr.register(name, plugin.create_connector(
+                    kind, name, props))
+            except KeyError as e:
+                print(f"warning: {e} for catalog {name}",
+                      file=sys.stderr)
             made = True
     if not made:
-        mgr.register("tpch", TpchConnector())
-        mgr.register("tpcds", TpcdsConnector())
-        mgr.register("memory", MemoryConnector())
-        mgr.register("blackhole", BlackholeConnector())
+        for kind in ("tpch", "tpcds", "memory", "blackhole"):
+            mgr.register(kind, plugin.create_connector(kind, kind))
     return mgr
 
 
@@ -89,6 +77,9 @@ def main(argv=None) -> int:
         cfg = os.path.join(args.etc_dir, "config.properties")
         if os.path.exists(cfg):
             props = load_properties(cfg)
+    # plugin.load=<module>[,<module>...] loads external plugin modules
+    # before catalogs resolve (server/PluginManager.java)
+    plugins = [m for m in props.get("plugin.load", "").split(",") if m]
     port = args.port if args.port is not None else \
         int(props.get("http-server.http.port", "8080"))
 
@@ -110,7 +101,7 @@ def main(argv=None) -> int:
 
     co = Coordinator(port=port,
                      distributed=args.distributed,
-                     catalogs=build_catalogs(args.etc_dir),
+                     catalogs=build_catalogs(args.etc_dir, plugins),
                      resource_groups=resource_groups,
                      authenticator=authenticator).start()
     print(f"trino-tpu coordinator listening on {co.base_uri}"

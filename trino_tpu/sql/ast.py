@@ -516,6 +516,36 @@ class DescribeOutput(Statement):
 
 
 @dataclass(frozen=True)
+class Grant(Statement):
+    """GRANT privileges ON [TABLE] t TO grantee [WITH GRANT OPTION]
+    (reference: sql/tree/Grant.java, execution/GrantTask.java)."""
+    privileges: Tuple[str, ...] = ()   # empty = ALL PRIVILEGES
+    table: Tuple[str, ...] = ()
+    grantee: str = ""
+    grant_option: bool = False
+
+
+@dataclass(frozen=True)
+class Revoke(Statement):
+    privileges: Tuple[str, ...] = ()
+    table: Tuple[str, ...] = ()
+    grantee: str = ""
+    grant_option_for: bool = False
+
+
+@dataclass(frozen=True)
+class Deny(Statement):
+    privileges: Tuple[str, ...] = ()
+    table: Tuple[str, ...] = ()
+    grantee: str = ""
+
+
+@dataclass(frozen=True)
+class ShowGrants(Statement):
+    table: Optional[Tuple[str, ...]] = None
+
+
+@dataclass(frozen=True)
 class CallStatement(Statement):
     name: Tuple[str, ...] = ()
     args: Tuple[Expression, ...] = ()

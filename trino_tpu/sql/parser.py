@@ -153,6 +153,8 @@ class _Parser:
             return A.Explain(self._statement(), analyze, etype)
         if self.at_kw("show"):
             return self._show()
+        if self.at_kw("grant", "revoke", "deny"):
+            return self._grant()
         if self.at_kw("set"):
             self.next()
             self.expect_kw("session")
@@ -383,8 +385,72 @@ class _Parser:
         if self.accept_kw("stats"):
             self.expect_kw("for")
             return A.ShowStats(self.qualified_name())
+        if self.accept_kw("grants"):
+            table = None
+            if self.accept_kw("on"):
+                self.accept_kw("table")
+                table = self.qualified_name()
+            return A.ShowGrants(table)
         t = self.peek()
         raise ParseError(f"unsupported SHOW {t.value!r}", t.line, t.column)
+
+    _PRIVILEGES = ("select", "insert", "delete", "update")
+
+    def _privilege_list(self) -> Tuple[Tuple[str, ...], bool]:
+        """privilege [, ...] | ALL PRIVILEGES -> (privs, is_all)."""
+        if self.accept_kw("all"):
+            self.accept_kw("privileges")
+            return tuple(self._PRIVILEGES), True
+        privs = []
+        while True:
+            t = self.peek()
+            p = self.identifier().lower()
+            if p not in self._PRIVILEGES:
+                raise ParseError(f"unknown privilege {p!r}", t.line,
+                                 t.column)
+            privs.append(p)
+            if not self.accept_op(","):
+                break
+        return tuple(privs), False
+
+    def _grant(self) -> A.Statement:
+        """GRANT/REVOKE/DENY (reference: sql/tree/{Grant,Revoke,Deny}
+        grammar rules in SqlBase.g4)."""
+        if self.accept_kw("grant"):
+            privs, _ = self._privilege_list()
+            self.expect_kw("on")
+            self.accept_kw("table")
+            table = self.qualified_name()
+            self.expect_kw("to")
+            self.accept_kw("user", "role")
+            grantee = self.identifier()
+            opt = False
+            if self.accept_kw("with"):
+                self.expect_kw("grant")
+                self.expect_kw("option")
+                opt = True
+            return A.Grant(privs, table, grantee, opt)
+        if self.accept_kw("deny"):
+            privs, _ = self._privilege_list()
+            self.expect_kw("on")
+            self.accept_kw("table")
+            table = self.qualified_name()
+            self.expect_kw("to")
+            self.accept_kw("user", "role")
+            return A.Deny(privs, table, self.identifier())
+        self.expect_kw("revoke")
+        opt = False
+        if self.accept_kw("grant"):
+            self.expect_kw("option")
+            self.expect_kw("for")
+            opt = True
+        privs, _ = self._privilege_list()
+        self.expect_kw("on")
+        self.accept_kw("table")
+        table = self.qualified_name()
+        self.expect_kw("from")
+        self.accept_kw("user", "role")
+        return A.Revoke(privs, table, self.identifier(), opt)
 
     def _create_table(self) -> A.Statement:
         self.expect_kw("create")

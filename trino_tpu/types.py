@@ -30,6 +30,7 @@ __all__ = [
     "DOUBLE", "VARCHAR", "VARBINARY", "DATE", "UNKNOWN", "DecimalType",
     "VarcharType", "CharType", "TimestampType", "TimeType", "ArrayType",
     "MapType", "RowType", "HyperLogLogType", "HYPER_LOG_LOG",
+    "TDigestType", "T_DIGEST", "QDigestType",
     "IntervalDayTime", "IntervalYearMonth", "parse_type", "common_super_type",
     "is_numeric", "is_integral", "is_exact_numeric", "is_string",
 ]
@@ -101,6 +102,44 @@ class HyperLogLogType(Type):
 
 
 HYPER_LOG_LOG = HyperLogLogType()
+
+
+@dataclass(frozen=True)
+class TDigestType(Type):
+    """t-digest sketch (reference: spi/type/TDigestType + airlift-stats
+    TDigest). Physically like an ARRAY column: ``data`` = per-row start
+    into flat centroid lanes, ``data2`` = centroid count, ``elements`` =
+    centroid means (f64), ``elements2`` = centroid weights (f64)."""
+
+    compression: int = 100
+
+    def __init__(self, compression: int = 100):
+        object.__setattr__(self, "name", "tdigest")
+        object.__setattr__(self, "compression", compression)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)  # offset lane
+
+
+T_DIGEST = TDigestType()
+
+
+@dataclass(frozen=True)
+class QDigestType(Type):
+    """Quantile digest over a numeric type (spi/type/QDigestType).
+    Same physical layout as TDigestType; ``value_type`` drives the
+    result type of value_at_quantile."""
+
+    value_type: "Type" = None  # type: ignore
+
+    def __init__(self, value_type: "Type"):
+        object.__setattr__(self, "name", f"qdigest({value_type.name})")
+        object.__setattr__(self, "value_type", value_type)
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return np.dtype(np.int64)  # offset lane
 
 
 @dataclass(frozen=True)
@@ -444,6 +483,7 @@ _SIMPLE["string"] = VARCHAR
 _SIMPLE["varchar"] = VARCHAR
 _SIMPLE["timestamp"] = TimestampType(3)
 _SIMPLE["hyperloglog"] = HYPER_LOG_LOG
+_SIMPLE["tdigest"] = T_DIGEST
 _SIMPLE["p4hyperloglog"] = HYPER_LOG_LOG
 
 
