@@ -70,3 +70,48 @@ def test_jit_host_fallback(runner):
         FROM lineitem GROUP BY 1 ORDER BY 1
     """)
     assert eager == jitted
+
+
+def test_whole_table_hbm_path_matches_streaming(monkeypatch):
+    """The device-backend whole-table fast path (exec/executor.py
+    read_table_cached: splits concatenated once into an HBM-resident
+    batch, aggregation fused into ONE program incl. final combine +
+    post-processing) must agree with the default split-streaming path.
+    Forced on here via TRINO_TPU_WHOLE_TABLE=1 (it is auto-off on the
+    CPU test backend)."""
+    monkeypatch.setenv("TRINO_TPU_WHOLE_TABLE", "1")
+    r = LocalQueryRunner()
+    for q in (1, 6):
+        stmt = parse_statement(TPCH_QUERIES[q])
+        plan = optimize(
+            LogicalPlanner(r.catalogs, r.session).plan(stmt))
+        whole = Executor(r.catalogs, r.session,
+                         fragment_jit=True).execute(plan).to_pylist()
+        monkeypatch.setenv("TRINO_TPU_WHOLE_TABLE", "0")
+        stream = Executor(r.catalogs, r.session,
+                          fragment_jit=True).execute(plan).to_pylist()
+        monkeypatch.setenv("TRINO_TPU_WHOLE_TABLE", "1")
+        assert_rows_close(stream, whole)
+
+
+def test_structural_jit_cache_reuses_program(monkeypatch):
+    """Two separately planned executions of the same SQL must share one
+    cached streaming-aggregation program (plan-fingerprint keyed —
+    the ExpressionCompiler generated-class cache analog)."""
+    from trino_tpu.exec import executor as ex
+    monkeypatch.setenv("TRINO_TPU_WHOLE_TABLE", "1")
+    r = LocalQueryRunner()
+    sql = ("SELECT l_returnflag, sum(l_quantity), avg(l_discount) "
+           "FROM lineitem WHERE l_shipdate <= DATE '1998-09-02' "
+           "GROUP BY l_returnflag ORDER BY l_returnflag")
+    outs = []
+    for _ in range(2):
+        stmt = parse_statement(sql)
+        plan = optimize(
+            LogicalPlanner(r.catalogs, r.session).plan(stmt))
+        outs.append(Executor(r.catalogs, r.session,
+                             fragment_jit=True).execute(plan).to_pylist())
+    assert_rows_close(outs[0], outs[1])
+    # both executions landed on the same fingerprint entries
+    assert any(isinstance(k, tuple) and k and k[-1] == "full"
+               for k in ex._STREAM_JIT_CACHE)

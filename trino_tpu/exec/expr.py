@@ -126,7 +126,10 @@ def _dict_transform(col: Column, fn: Callable[[str], object],
     reused (possibly remapped through a new dictionary)."""
     vals = col.dictionary.values
     out = [fn(str(v)) for v in vals]
-    if is_string(out_type):
+    if is_string(out_type) \
+            or getattr(out_type, "name", "") == "varbinary":
+        # varbinary rides the dictionary-string lanes (latin-1-decoded
+        # raw bytes), same as varchar
         d, codes = StringDictionary.from_strings(out)
         table = jnp.asarray(codes.astype(np.int32))
         data = jnp.take(table, _lane(col), mode="clip")
@@ -2265,12 +2268,32 @@ def _hmac(algo):
 
 
 def _retype_string(e, batch):
-    """to_utf8 / from_utf8 / json_format: identity on the carried string,
+    """json_format / color / render: identity on the carried string,
     retyped (varbinary is a dictionary column like varchar)."""
     a = eval_expr(e.args[0], batch)
     if a.dictionary is None:
         return dc_replace(a, type=e.type)
     return Column(e.type, a.data, a.valid, a.dictionary)
+
+
+def _to_utf8(e, batch):
+    """varchar -> varbinary holding the text's REAL utf-8 bytes in the
+    latin-1-decoded carried-string convention of _num_to_binary (so
+    hmac_*/md5/length over the result see the actual byte sequence,
+    including for non-latin-1 text)."""
+    a = eval_expr(e.args[0], batch)
+    return _dict_transform(
+        a, lambda s: s.encode("utf-8").decode("latin-1"), e.type)
+
+
+def _from_utf8(e, batch):
+    """varbinary (latin-1-carried raw bytes) -> varchar text, invalid
+    sequences replaced with U+FFFD (reference
+    VarbinaryFunctions.fromUtf8 default behavior)."""
+    a = eval_expr(e.args[0], batch)
+    return _dict_transform(
+        a, lambda s: s.encode("latin-1", errors="replace")
+                      .decode("utf-8", errors="replace"), e.type)
 
 
 def _json_parse(e, batch):
@@ -2606,7 +2629,7 @@ def _pack_fns():
 _DISPATCH_R4 = {
     "hmac_md5": _hmac("md5"), "hmac_sha1": _hmac("sha1"),
     "hmac_sha256": _hmac("sha256"), "hmac_sha512": _hmac("sha512"),
-    "to_utf8": _retype_string, "from_utf8": _retype_string,
+    "to_utf8": _to_utf8, "from_utf8": _from_utf8,
     "json_format": _retype_string, "json_parse": _json_parse,
     "bar": _bar_fn,
     "color": _retype_string, "render": _retype_string,

@@ -90,7 +90,8 @@ def aggregate_result_type(name: str, arg_types: Sequence[Type]) -> Type:
         # APPROX_SET_BUCKET_BITS); an explicit max-error argument
         # re-types the aggregate at plan time (planner/logical.py)
         from .types import HyperLogLogType
-        return HyperLogLogType(12)
+        from .ops.hll import APPROX_SET_BUCKET_BITS
+        return HyperLogLogType(APPROX_SET_BUCKET_BITS)
     if name == "merge":
         # merge() combines sketch values — result type follows the
         # input (HLL, tdigest or qdigest, like the reference)
@@ -425,7 +426,8 @@ def _hll_type():
     # matches approx_set's default bucket count so empty_approx_set()
     # merges with approx_set(x) sketches (APPROX_SET_BUCKET_BITS)
     from .types import HyperLogLogType
-    return HyperLogLogType(12)
+    from .ops.hll import APPROX_SET_BUCKET_BITS
+    return HyperLogLogType(APPROX_SET_BUCKET_BITS)
 
 
 def _array_elem(name, args):

@@ -43,13 +43,17 @@ def rows_by_group(order, gid, valid_s, gcap: int) -> List[np.ndarray]:
     order = np.asarray(jax.device_get(order))
     gid = np.asarray(jax.device_get(gid))
     valid_s = np.asarray(jax.device_get(valid_s))
-    groups: List[List[int]] = [[] for _ in range(gcap)]
-    for pos in range(order.shape[0]):
-        if valid_s[pos]:
-            g = int(gid[pos])
-            if 0 <= g < gcap:
-                groups[g].append(int(order[pos]))
-    return [np.asarray(g, dtype=np.int64) for g in groups]
+    keep = valid_s & (gid >= 0) & (gid < gcap)
+    g = gid[keep]
+    rows = order[keep].astype(np.int64)
+    # stable sort by group keeps rows in group-sorted row order, then
+    # one split at the group boundaries — no per-row python loop (an
+    # 8M-capacity batch spends seconds in the interpreter otherwise)
+    perm = np.argsort(g, kind="stable")
+    g = g[perm]
+    rows = rows[perm]
+    bounds = np.searchsorted(g, np.arange(gcap + 1))
+    return [rows[bounds[i]:bounds[i + 1]] for i in range(gcap)]
 
 
 def _entry_key_fn(col: Column):
@@ -156,7 +160,10 @@ def _merge_histogram(values: np.ndarray, buckets: int,
                      weights: Optional[np.ndarray] = None):
     """Greedy adjacent-merge of sorted (x, w) pairs until <= buckets —
     the same centroid-merging idea as the reference's NumericHistogram
-    (it merges the two closest buckets on overflow)."""
+    (it merges the two closest buckets on overflow). Dedupe + weight
+    accumulation here; the linked-list/heap merge loop is shared with
+    the digest sketches (ops/digest.py _compress)."""
+    from .digest import _compress
     if values.size == 0:
         return [], []
     if weights is None:
@@ -167,35 +174,10 @@ def _merge_histogram(values: np.ndarray, buckets: int,
         ws = np.zeros(xs.size, np.float64)
         np.add.at(ws, inv, weights.astype(np.float64))
     xs = xs.astype(np.float64)
-    n = xs.size
-    if n <= buckets:
+    if xs.size <= buckets:
         return list(xs), list(ws)
-    # doubly-linked list + heap of adjacent gaps
-    prev = list(range(-1, n - 1))
-    nxt = list(range(1, n + 1))
-    alive = [True] * n
-    x = list(xs)
-    w = list(ws)
-    heap = [(x[i + 1] - x[i], i, i + 1) for i in range(n - 1)]
-    heapq.heapify(heap)
-    remaining = n
-    while remaining > buckets and heap:
-        _, i, j = heapq.heappop(heap)
-        if not (alive[i] and alive[j]) or nxt[i] != j:
-            continue
-        tot = w[i] + w[j]
-        x[i] = (x[i] * w[i] + x[j] * w[j]) / tot
-        w[i] = tot
-        alive[j] = False
-        nxt[i] = nxt[j]
-        if nxt[i] < n:
-            prev[nxt[i]] = i
-            heapq.heappush(heap, (x[nxt[i]] - x[i], i, nxt[i]))
-        if prev[i] >= 0:
-            heapq.heappush(heap, (x[i] - x[prev[i]], prev[i], i))
-        remaining -= 1
-    keep = [i for i in range(n) if alive[i]]
-    return [x[i] for i in keep], [w[i] for i in keep]
+    x, w = _compress(xs, ws, buckets)
+    return list(x), list(w)
 
 
 def grouped_numeric_histogram(col: Column, groups: List[np.ndarray],

@@ -126,8 +126,8 @@ def _static_domain(col: Column) -> Optional[int]:
 
 def _packed_group_aggregate(batch: Batch, key_names: Sequence[str],
                             aggs: Sequence[AggInput], gcap: int,
-                            live: Optional[jax.Array] = None
-                            ) -> Optional[Batch]:
+                            live: Optional[jax.Array] = None,
+                            clamp: bool = False) -> Optional[Batch]:
     """Small-static-domain GROUP BY: one packed int32 group id per row,
     every aggregate an unrolled per-group masked reduction (VPU-friendly,
     single fused pass over HBM)."""
@@ -149,6 +149,16 @@ def _packed_group_aggregate(batch: Batch, key_names: Sequence[str],
         return None
     if any(a.kind not in _FAST_KINDS for a in aggs):
         return None
+    if clamp:
+        # The packed domain bounds the group count, so the output needs
+        # at most nseg slots — NOT the input capacity the default gcap
+        # inherits. Without this, a 6-group q1 aggregation emits 8M-row
+        # output lanes and the downstream sort lexsorts 8M slots for 4
+        # live rows (measured: ~20s of the sf1 engine path). Callers
+        # that pass an explicit groups_capacity are asserting a shape
+        # contract (distributed shard exchanges) — never clamp those.
+        from ..config import capacity_for
+        gcap = min(gcap, capacity_for(nseg, minimum=1))
 
     cap = batch.capacity
     if live is None:
@@ -396,7 +406,8 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     """
     cap = batch.capacity
     gcap = groups_capacity or cap
-    fast = _packed_group_aggregate(batch, key_names, aggs, gcap, live)
+    fast = _packed_group_aggregate(batch, key_names, aggs, gcap, live,
+                                   clamp=groups_capacity is None)
     if fast is not None:
         return fast
     live = batch.row_valid() if live is None else live
@@ -667,11 +678,30 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         groups = rows_by_group(order, gid, valid, gcap)
         if agg.kind == "digest_merge":
             return grouped_digest_merge(col, groups, group_valid,
-                                        DEFAULT_COMPRESSION)
+                                        _merge_budget(col))
         return _grouped_digest_build(batch, agg, col, groups,
                                      group_valid)
 
     raise ValueError(f"unknown aggregate kind {agg.kind}")
+
+
+def _merge_budget(col: Column) -> int:
+    """Recompression budget for merge(digest): qdigest sketches carry
+    an accuracy budget (2/accuracy nodes) that a merge must not shrink
+    — recompressing a 400-node qdigest to tdigest's 100 centroids
+    would quadruple the user's requested quantile error. Honor the
+    LARGEST input run so merged sketches keep their builders' budget
+    (reference: QuantileDigest.merge keeps maxError)."""
+    from ..types import QDigestType
+    from .digest import DEFAULT_COMPRESSION, DEFAULT_QDIGEST_BUDGET
+    base = (DEFAULT_QDIGEST_BUDGET if isinstance(col.type, QDigestType)
+            else DEFAULT_COMPRESSION)
+    if col.data2 is not None:
+        import numpy as _np
+        lens = _np.asarray(jax.device_get(col.data2))
+        if lens.size:
+            base = max(base, int(lens.max()))
+    return base
 
 
 def _grouped_digest_build(batch: Batch, agg: AggInput, col: Column,
@@ -1038,7 +1068,7 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             groups = rows_by_group(ident, gid0, valid, 1)
             if agg.kind == "digest_merge":
                 out[agg.output] = grouped_digest_merge(
-                    col, groups, has, DEFAULT_COMPRESSION)
+                    col, groups, has, _merge_budget(col))
             else:
                 out[agg.output] = _grouped_digest_build(
                     batch, agg, col, groups, has)
