@@ -26,43 +26,84 @@ _ORACLE_TABLES = {
     "item": ["i_item_sk", "i_item_id", "i_product_name",
              "i_item_desc", "i_color", "i_current_price",
              "i_wholesale_cost", "i_brand_id", "i_brand",
-             "i_manufact_id", "i_category_id", "i_category",
-             "i_class_id", "i_class", "i_manager_id"],
-    "store_sales": ["ss_sold_date_sk", "ss_item_sk", "ss_customer_sk",
+             "i_manufact_id", "i_manufact", "i_category_id",
+             "i_category", "i_class_id", "i_class", "i_manager_id"],
+    "store_sales": ["ss_sold_date_sk", "ss_sold_time_sk",
+                    "ss_item_sk", "ss_customer_sk",
                     "ss_cdemo_sk", "ss_hdemo_sk", "ss_addr_sk",
                     "ss_store_sk", "ss_promo_sk", "ss_ticket_number",
                     "ss_quantity", "ss_wholesale_cost", "ss_list_price",
                     "ss_sales_price", "ss_ext_sales_price",
+                    "ss_ext_wholesale_cost", "ss_ext_list_price",
+                    "ss_ext_tax",
                     "ss_coupon_amt", "ss_net_profit"],
     "store_returns": ["sr_item_sk", "sr_ticket_number",
                       "sr_returned_date_sk", "sr_customer_sk",
-                      "sr_store_sk", "sr_return_quantity",
+                      "sr_store_sk", "sr_reason_sk", "sr_cdemo_sk",
+                      "sr_return_quantity",
                       "sr_return_amt", "sr_net_loss"],
     "catalog_sales": ["cs_item_sk", "cs_order_number",
                       "cs_ext_list_price", "cs_sold_date_sk",
-                      "cs_bill_customer_sk", "cs_quantity",
+                      "cs_ship_date_sk", "cs_bill_customer_sk",
+                      "cs_bill_cdemo_sk", "cs_promo_sk",
+                      "cs_warehouse_sk", "cs_ship_mode_sk",
+                      "cs_call_center_sk", "cs_quantity",
+                      "cs_list_price", "cs_coupon_amt",
+                      "cs_ext_discount_amt", "cs_ext_sales_price",
+                      "cs_ship_addr_sk", "cs_ext_ship_cost",
+                      "cs_bill_addr_sk",
                       "cs_sales_price", "cs_net_profit"],
     "catalog_returns": ["cr_item_sk", "cr_order_number",
                         "cr_refunded_cash", "cr_reversed_charge",
-                        "cr_store_credit"],
+                        "cr_store_credit", "cr_net_loss",
+                        "cr_returned_date_sk",
+                        "cr_returning_customer_sk",
+                        "cr_call_center_sk"],
     "store": ["s_store_sk", "s_store_id", "s_store_name", "s_zip",
               "s_state", "s_city", "s_number_employees", "s_county",
               "s_company_name"],
     "customer": ["c_customer_sk", "c_customer_id",
                  "c_first_name", "c_last_name", "c_current_cdemo_sk",
                  "c_current_hdemo_sk", "c_current_addr_sk",
-                 "c_first_sales_date_sk", "c_first_shipto_date_sk"],
+                 "c_first_sales_date_sk", "c_first_shipto_date_sk",
+                 "c_birth_year", "c_birth_month", "c_salutation",
+                 "c_preferred_cust_flag"],
     "customer_demographics": ["cd_demo_sk", "cd_gender",
                               "cd_marital_status",
-                              "cd_education_status"],
+                              "cd_education_status", "cd_dep_count"],
     "household_demographics": ["hd_demo_sk", "hd_income_band_sk",
                                "hd_buy_potential", "hd_dep_count",
                                "hd_vehicle_count"],
     "customer_address": ["ca_address_sk", "ca_street_number",
                          "ca_street_name", "ca_city", "ca_zip",
-                         "ca_state", "ca_country"],
-    "income_band": ["ib_income_band_sk"],
+                         "ca_state", "ca_country", "ca_county",
+                         "ca_gmt_offset"],
+    "income_band": ["ib_income_band_sk", "ib_lower_bound",
+                    "ib_upper_bound"],
     "promotion": ["p_promo_sk", "p_channel_email", "p_channel_event"],
+    "web_sales": ["ws_sold_date_sk", "ws_sold_time_sk",
+                  "ws_ship_date_sk", "ws_item_sk",
+                  "ws_order_number", "ws_warehouse_sk",
+                  "ws_web_site_sk", "ws_ship_mode_sk",
+                  "ws_web_page_sk", "ws_ship_hdemo_sk",
+                  "ws_bill_customer_sk", "ws_bill_addr_sk",
+                  "ws_ship_addr_sk",
+                  "ws_ext_sales_price", "ws_ext_discount_amt",
+                  "ws_ext_ship_cost", "ws_net_paid",
+                  "ws_sales_price", "ws_ship_customer_sk",
+                  "ws_net_profit"],
+    "warehouse": ["w_warehouse_sk", "w_warehouse_name"],
+    "ship_mode": ["sm_ship_mode_sk", "sm_type"],
+    "web_site": ["web_site_sk", "web_name", "web_company_name"],
+    "web_page": ["wp_web_page_sk", "wp_char_count"],
+    "web_returns": ["wr_item_sk", "wr_order_number",
+                    "wr_returned_date_sk"],
+    "call_center": ["cc_call_center_sk", "cc_call_center_id",
+                    "cc_name", "cc_manager", "cc_county"],
+    "time_dim": ["t_time_sk", "t_hour", "t_minute"],
+    "reason": ["r_reason_sk", "r_reason_desc"],
+    "inventory": ["inv_date_sk", "inv_item_sk", "inv_warehouse_sk",
+                  "inv_quantity_on_hand"],
 }
 
 
@@ -88,8 +129,18 @@ def oracle(local):
 
 
 def norm_row(row):
-    return [v.isoformat() if isinstance(v, datetime.date)
-            else float(v) if isinstance(v, Decimal) else v for v in row]
+    # sqlite yields NULL for division by zero where the engine follows
+    # IEEE double semantics (0.0/0.0 = NaN): normalize NaN to None
+    out = []
+    for v in row:
+        if isinstance(v, datetime.date):
+            v = v.isoformat()
+        elif isinstance(v, Decimal):
+            v = float(v)
+        if isinstance(v, float) and math.isnan(v):
+            v = None
+        out.append(v)
+    return out
 
 
 def assert_rows_equal(got, want, tag, ordered):
@@ -161,8 +212,217 @@ WHERE s_store_sk = ss_store_sk
            AND ss_net_profit BETWEEN 50 AND 25000))
 """
 
+_Q86_BODY = """
+FROM web_sales, date_dim d1, item
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ws_sold_date_sk
+  AND i_item_sk = ws_item_sk
+"""
+
+_Q22_BODY = """
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk
+  AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+"""
+
+# q13: same sqlite nested-loop hazard as q48 — hoist the join
+# conjuncts that the official text repeats inside each OR arm
+_Q13_ORACLE = """
+SELECT avg(ss_quantity) q, avg(ss_ext_sales_price) esp,
+       avg(ss_ext_wholesale_cost) ewc, sum(ss_ext_wholesale_cost) swc
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+  AND ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+  AND ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+  AND ((cd_marital_status = 'M'
+        AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00
+        AND hd_dep_count = 3)
+       OR (cd_marital_status = 'S'
+           AND cd_education_status = 'College'
+           AND ss_sales_price BETWEEN 50.00 AND 100.00
+           AND hd_dep_count = 1)
+       OR (cd_marital_status = 'W'
+           AND cd_education_status = '2 yr Degree'
+           AND ss_sales_price BETWEEN 150.00 AND 200.00
+           AND hd_dep_count = 1))
+  AND ((ca_state IN ('TX', 'OH', 'TX')
+        AND ss_net_profit BETWEEN 100 AND 200)
+       OR (ca_state IN ('OR', 'NM', 'KY')
+           AND ss_net_profit BETWEEN 150 AND 300)
+       OR (ca_state IN ('VA', 'TX', 'MS')
+           AND ss_net_profit BETWEEN 50 AND 250))
+"""
+
+_Q18_BODY = """
+FROM catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F'
+  AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+  AND d_year = 1998
+  AND ca_state IN ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'MS')
+"""
+_Q18_AGGS = """avg(cs_quantity), avg(cs_list_price),
+       avg(cs_coupon_amt), avg(cs_sales_price), avg(cs_net_profit),
+       avg(c_birth_year), avg(cd1.cd_dep_count)"""
+
+_Q36_BODY = """
+FROM store_sales, date_dim d1, item, store
+WHERE d1.d_year = 2001
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state IN ('TN', 'OH', 'TX', 'GA', 'IL')
+"""
+
 _ORACLE_OVERRIDE = {
     48: _Q48_ORACLE,
+    13: _Q13_ORACLE,
+    # sqlite rejects parenthesized compound-select members: restate
+    # q8/q87 with bare INTERSECT/EXCEPT (left-assoc, same semantics)
+    8: """
+SELECT s_store_name, sum(ss_net_profit) profit
+FROM store_sales, date_dim, store,
+     (SELECT substr(ca_zip, 1, 5) ca_zip
+      FROM customer_address
+      WHERE substr(ca_zip, 1, 5) IN
+            ('24250', '38800', '50440', '59170', '75369',
+             '77697', '86136', '87494', '92635', '97000')
+      INTERSECT
+      SELECT ca_zip
+      FROM (SELECT substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+            FROM customer_address, customer
+            WHERE ca_address_sk = c_current_addr_sk
+              AND c_preferred_cust_flag = 'Y'
+            GROUP BY substr(ca_zip, 1, 5)
+            HAVING count(*) > 1) a1) v1
+WHERE ss_store_sk = s_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 1998
+  AND substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+GROUP BY s_store_name
+ORDER BY s_store_name
+LIMIT 100
+""",
+    87: """
+SELECT count(*) cnt
+FROM (SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM store_sales, date_dim, customer
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_customer_sk = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211
+      EXCEPT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM catalog_sales, date_dim, customer
+      WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+        AND catalog_sales.cs_bill_customer_sk
+            = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211
+      EXCEPT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM web_sales, date_dim, customer
+      WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+        AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211) cool_cust
+""",
+    18: f"""
+SELECT * FROM (
+  SELECT i_item_id, ca_country, ca_state, ca_county, {_Q18_AGGS}
+  {_Q18_BODY} GROUP BY i_item_id, ca_country, ca_state, ca_county
+  UNION ALL
+  SELECT i_item_id, ca_country, ca_state, NULL, {_Q18_AGGS}
+  {_Q18_BODY} GROUP BY i_item_id, ca_country, ca_state
+  UNION ALL
+  SELECT i_item_id, ca_country, NULL, NULL, {_Q18_AGGS}
+  {_Q18_BODY} GROUP BY i_item_id, ca_country
+  UNION ALL
+  SELECT i_item_id, NULL, NULL, NULL, {_Q18_AGGS}
+  {_Q18_BODY} GROUP BY i_item_id
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL, {_Q18_AGGS} {_Q18_BODY})
+ORDER BY ca_country NULLS LAST, ca_state NULLS LAST,
+         ca_county NULLS LAST, i_item_id NULLS LAST
+LIMIT 100
+""",
+    36: f"""
+SELECT gross_margin, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN cls_grouping = 0
+                             THEN i_category END
+                    ORDER BY gross_margin) rank_within_parent
+FROM (SELECT sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price)
+                 gross_margin,
+             i_category, i_class, 0 lochierarchy, 0 cls_grouping
+      {_Q36_BODY} GROUP BY i_category, i_class
+      UNION ALL
+      SELECT sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+             i_category, NULL, 1, 1
+      {_Q36_BODY} GROUP BY i_category
+      UNION ALL
+      SELECT sum(ss_net_profit) * 1.0 / sum(ss_ext_sales_price),
+             NULL, NULL, 2, 1
+      {_Q36_BODY}) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+""",
+    # sqlite has no ROLLUP: q22 expands to the 5 grouping levels
+    22: f"""
+SELECT * FROM (
+  SELECT i_product_name, i_brand, i_class, i_category,
+         avg(inv_quantity_on_hand) qoh {_Q22_BODY}
+  GROUP BY i_product_name, i_brand, i_class, i_category
+  UNION ALL
+  SELECT i_product_name, i_brand, i_class, NULL,
+         avg(inv_quantity_on_hand) {_Q22_BODY}
+  GROUP BY i_product_name, i_brand, i_class
+  UNION ALL
+  SELECT i_product_name, i_brand, NULL, NULL,
+         avg(inv_quantity_on_hand) {_Q22_BODY}
+  GROUP BY i_product_name, i_brand
+  UNION ALL
+  SELECT i_product_name, NULL, NULL, NULL,
+         avg(inv_quantity_on_hand) {_Q22_BODY}
+  GROUP BY i_product_name
+  UNION ALL
+  SELECT NULL, NULL, NULL, NULL,
+         avg(inv_quantity_on_hand) {_Q22_BODY})
+ORDER BY qoh, i_product_name NULLS LAST, i_brand NULLS LAST,
+         i_class NULLS LAST, i_category NULLS LAST
+LIMIT 100
+""",
+    # sqlite has no ROLLUP: expand q86's grouping levels as UNION ALL
+    86: f"""
+SELECT total_sum, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN cls_grouping = 0
+                             THEN i_category END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM (SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+             0 lochierarchy, 0 cls_grouping {_Q86_BODY}
+      GROUP BY i_category, i_class
+      UNION ALL
+      SELECT sum(ws_net_paid), i_category, NULL, 1, 1 {_Q86_BODY}
+      GROUP BY i_category
+      UNION ALL
+      SELECT sum(ws_net_paid), NULL, NULL, 2, 1 {_Q86_BODY}) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+""",
     27: f"""
 SELECT * FROM (
   SELECT i_item_id, s_state, avg(ss_quantity) agg1,

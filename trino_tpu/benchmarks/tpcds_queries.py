@@ -549,4 +549,900 @@ WHERE cs1.item_sk = cs2.item_sk
   AND cs1.store_zip = cs2.store_zip
 ORDER BY cs1.product_name, cs1.store_name, cs2.cnt, 14, 15, 16, 17, 18
 """,
+    # ---- round-4 batch: web/catalog channels, inventory, time_dim ----
+    12: """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue,
+       itemrevenue * 100.0
+           / sum(itemrevenue) OVER (PARTITION BY i_class) revenueratio
+FROM (SELECT i_item_id, i_item_desc, i_category, i_class,
+             i_current_price, sum(ws_ext_sales_price) itemrevenue
+      FROM web_sales, item, date_dim
+      WHERE ws_item_sk = i_item_sk
+        AND i_category IN ('Sports', 'Books', 'Home')
+        AND ws_sold_date_sk = d_date_sk
+        AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-03-24'
+      GROUP BY i_item_id, i_item_desc, i_category, i_class,
+               i_current_price) t
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+""",
+    20: """
+SELECT i_item_id, i_item_desc, i_category, i_class, i_current_price,
+       itemrevenue,
+       itemrevenue * 100.0
+           / sum(itemrevenue) OVER (PARTITION BY i_class) revenueratio
+FROM (SELECT i_item_id, i_item_desc, i_category, i_class,
+             i_current_price, sum(cs_ext_sales_price) itemrevenue
+      FROM catalog_sales, item, date_dim
+      WHERE cs_item_sk = i_item_sk
+        AND i_category IN ('Sports', 'Books', 'Home')
+        AND cs_sold_date_sk = d_date_sk
+        AND d_date BETWEEN DATE '1999-02-22' AND DATE '1999-03-24'
+      GROUP BY i_item_id, i_item_desc, i_category, i_class,
+               i_current_price) t
+ORDER BY i_category, i_class, i_item_id, i_item_desc, revenueratio
+LIMIT 100
+""",
+    26: """
+SELECT i_item_id,
+       avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4
+FROM catalog_sales, customer_demographics, date_dim, item, promotion
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd_demo_sk
+  AND cs_promo_sk = p_promo_sk
+  AND cd_gender = 'M' AND cd_marital_status = 'S'
+  AND cd_education_status = 'College'
+  AND (p_channel_email = 'N' OR p_channel_event = 'N')
+  AND d_year = 2000
+GROUP BY i_item_id
+ORDER BY i_item_id
+LIMIT 100
+""",
+    32: """
+SELECT sum(cs_ext_discount_amt) excess_discount_amount
+FROM catalog_sales cs1, item, date_dim
+WHERE i_manufact_id = 977
+  AND i_item_sk = cs1.cs_item_sk
+  AND d_date BETWEEN DATE '2000-01-27' AND DATE '2000-04-26'
+  AND d_date_sk = cs1.cs_sold_date_sk
+  AND cs1.cs_ext_discount_amt
+      > (SELECT 1.3 * avg(cs_ext_discount_amt)
+         FROM catalog_sales cs2, date_dim d2
+         WHERE cs2.cs_item_sk = cs1.cs_item_sk
+           AND d2.d_date BETWEEN DATE '2000-01-27'
+                             AND DATE '2000-04-26'
+           AND d2.d_date_sk = cs2.cs_sold_date_sk)
+LIMIT 100
+""",
+    37: """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, catalog_sales
+WHERE i_current_price BETWEEN 68 AND 98
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '2000-02-01' AND DATE '2000-04-01'
+  AND i_manufact_id IN (677, 940, 694, 808)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND cs_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+""",
+    62: """
+SELECT substr(w_warehouse_name, 1, 20) wname, sm_type, web_name,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS days30,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 30
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS days31_60,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 60
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) AS days61_90,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 90
+                 AND ws_ship_date_sk - ws_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) AS days91_120,
+       sum(CASE WHEN ws_ship_date_sk - ws_sold_date_sk > 120
+                THEN 1 ELSE 0 END) AS days_over_120
+FROM web_sales, warehouse, ship_mode, web_site, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND ws_ship_date_sk = d_date_sk
+  AND ws_warehouse_sk = w_warehouse_sk
+  AND ws_ship_mode_sk = sm_ship_mode_sk
+  AND ws_web_site_sk = web_site_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, web_name
+ORDER BY wname, sm_type, web_name
+LIMIT 100
+""",
+    82: """
+SELECT i_item_id, i_item_desc, i_current_price
+FROM item, inventory, date_dim, store_sales
+WHERE i_current_price BETWEEN 62 AND 92
+  AND inv_item_sk = i_item_sk
+  AND d_date_sk = inv_date_sk
+  AND d_date BETWEEN DATE '2000-05-25' AND DATE '2000-07-24'
+  AND i_manufact_id IN (129, 270, 821, 423)
+  AND inv_quantity_on_hand BETWEEN 100 AND 500
+  AND ss_item_sk = i_item_sk
+GROUP BY i_item_id, i_item_desc, i_current_price
+ORDER BY i_item_id
+LIMIT 100
+""",
+    86: """
+SELECT total_sum, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN cls_grouping = 0
+                             THEN i_category END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM (SELECT sum(ws_net_paid) total_sum, i_category, i_class,
+             grouping(i_category) + grouping(i_class) lochierarchy,
+             grouping(i_class) cls_grouping
+      FROM web_sales, date_dim d1, item
+      WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+        AND d1.d_date_sk = ws_sold_date_sk
+        AND i_item_sk = ws_item_sk
+      GROUP BY ROLLUP (i_category, i_class)) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+""",
+    92: """
+SELECT sum(ws_ext_discount_amt) excess_discount_amount
+FROM web_sales ws1, item, date_dim
+WHERE i_manufact_id = 350
+  AND i_item_sk = ws1.ws_item_sk
+  AND d_date BETWEEN DATE '2000-01-27' AND DATE '2000-04-26'
+  AND d_date_sk = ws1.ws_sold_date_sk
+  AND ws1.ws_ext_discount_amt
+      > (SELECT 1.3 * avg(ws_ext_discount_amt)
+         FROM web_sales ws2, date_dim d2
+         WHERE ws2.ws_item_sk = ws1.ws_item_sk
+           AND d2.d_date BETWEEN DATE '2000-01-27'
+                             AND DATE '2000-04-26'
+           AND d2.d_date_sk = ws2.ws_sold_date_sk)
+ORDER BY excess_discount_amount
+LIMIT 100
+""",
+    93: """
+SELECT ss_customer_sk, sum(act_sales) sumsales
+FROM (SELECT ss_customer_sk,
+             CASE WHEN sr_return_quantity IS NOT NULL
+                  THEN (ss_quantity - sr_return_quantity)
+                       * ss_sales_price
+                  ELSE ss_quantity * ss_sales_price END act_sales
+      FROM store_sales
+      LEFT JOIN store_returns ON sr_item_sk = ss_item_sk
+                             AND sr_ticket_number = ss_ticket_number,
+           reason
+      WHERE sr_reason_sk = r_reason_sk
+        AND r_reason_desc = 'reason 28') t
+GROUP BY ss_customer_sk
+ORDER BY sumsales, ss_customer_sk
+LIMIT 100
+""",
+    96: """
+SELECT count(*) cnt
+FROM store_sales, household_demographics, time_dim, store
+WHERE ss_sold_time_sk = time_dim.t_time_sk
+  AND ss_hdemo_sk = household_demographics.hd_demo_sk
+  AND ss_store_sk = s_store_sk
+  AND time_dim.t_hour = 20
+  AND time_dim.t_minute >= 30
+  AND household_demographics.hd_dep_count = 7
+  AND store.s_store_name = 'ese'
+ORDER BY count(*)
+LIMIT 100
+""",
+    99: """
+SELECT substr(w_warehouse_name, 1, 20) wname, sm_type, cc_name,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS days30,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 30
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS days31_60,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 60
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) AS days61_90,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 90
+                 AND cs_ship_date_sk - cs_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) AS days91_120,
+       sum(CASE WHEN cs_ship_date_sk - cs_sold_date_sk > 120
+                THEN 1 ELSE 0 END) AS days_over_120
+FROM catalog_sales, warehouse, ship_mode, call_center, date_dim
+WHERE d_month_seq BETWEEN 1200 AND 1211
+  AND cs_ship_date_sk = d_date_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_ship_mode_sk = sm_ship_mode_sk
+  AND cs_call_center_sk = cc_call_center_sk
+GROUP BY substr(w_warehouse_name, 1, 20), sm_type, cc_name
+ORDER BY wname, sm_type, cc_name
+LIMIT 100
+""",
+    13: """
+SELECT avg(ss_quantity) q, avg(ss_ext_sales_price) esp,
+       avg(ss_ext_wholesale_cost) ewc, sum(ss_ext_wholesale_cost) swc
+FROM store_sales, store, customer_demographics,
+     household_demographics, customer_address, date_dim
+WHERE s_store_sk = ss_store_sk
+  AND ss_sold_date_sk = d_date_sk AND d_year = 2001
+  AND ((ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+        AND cd_marital_status = 'M'
+        AND cd_education_status = 'Advanced Degree'
+        AND ss_sales_price BETWEEN 100.00 AND 150.00
+        AND hd_dep_count = 3)
+       OR (ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+           AND cd_marital_status = 'S'
+           AND cd_education_status = 'College'
+           AND ss_sales_price BETWEEN 50.00 AND 100.00
+           AND hd_dep_count = 1)
+       OR (ss_hdemo_sk = hd_demo_sk AND cd_demo_sk = ss_cdemo_sk
+           AND cd_marital_status = 'W'
+           AND cd_education_status = '2 yr Degree'
+           AND ss_sales_price BETWEEN 150.00 AND 200.00
+           AND hd_dep_count = 1))
+  AND ((ss_addr_sk = ca_address_sk AND ca_country = 'United States'
+        AND ca_state IN ('TX', 'OH', 'TX')
+        AND ss_net_profit BETWEEN 100 AND 200)
+       OR (ss_addr_sk = ca_address_sk
+           AND ca_country = 'United States'
+           AND ca_state IN ('OR', 'NM', 'KY')
+           AND ss_net_profit BETWEEN 150 AND 300)
+       OR (ss_addr_sk = ca_address_sk
+           AND ca_country = 'United States'
+           AND ca_state IN ('VA', 'TX', 'MS')
+           AND ss_net_profit BETWEEN 50 AND 250))
+""",
+    16: """
+SELECT count(DISTINCT cs_order_number) order_count,
+       sum(cs_ext_ship_cost) total_shipping_cost,
+       sum(cs_net_profit) total_net_profit
+FROM catalog_sales cs1, date_dim, customer_address, call_center
+WHERE d_date BETWEEN DATE '2002-02-01' AND DATE '2002-04-02'
+  AND cs1.cs_ship_date_sk = d_date_sk
+  AND cs1.cs_ship_addr_sk = ca_address_sk
+  AND ca_state = 'GA'
+  AND cs1.cs_call_center_sk = cc_call_center_sk
+  AND cc_county = 'Williamson County'
+  AND EXISTS (SELECT *
+              FROM catalog_sales cs2
+              WHERE cs1.cs_order_number = cs2.cs_order_number
+                AND cs1.cs_warehouse_sk <> cs2.cs_warehouse_sk)
+  AND NOT EXISTS (SELECT *
+                  FROM catalog_returns cr1
+                  WHERE cs1.cs_order_number = cr1.cr_order_number)
+ORDER BY count(DISTINCT cs_order_number)
+LIMIT 100
+""",
+    19: """
+SELECT i_brand_id brand_id, i_brand brand, i_manufact_id, i_manufact,
+       sum(ss_ext_sales_price) ext_price
+FROM date_dim, store_sales, item, customer, customer_address, store
+WHERE d_date_sk = ss_sold_date_sk
+  AND ss_item_sk = i_item_sk
+  AND i_manager_id = 8
+  AND d_moy = 11 AND d_year = 1998
+  AND ss_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND ss_store_sk = s_store_sk
+  AND substr(ca_zip, 1, 5) <> substr(s_zip, 1, 5)
+GROUP BY i_brand, i_brand_id, i_manufact_id, i_manufact
+ORDER BY ext_price DESC, i_brand, i_brand_id, i_manufact_id,
+         i_manufact
+LIMIT 100
+""",
+    21: """
+SELECT w_warehouse_name, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) inv_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN inv_quantity_on_hand ELSE 0 END) inv_after
+FROM inventory, warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 1.49
+  AND i_item_sk = inv_item_sk
+  AND inv_warehouse_sk = w_warehouse_sk
+  AND inv_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_warehouse_name, i_item_id
+HAVING (CASE WHEN sum(CASE WHEN d_date < DATE '2000-03-11'
+                           THEN inv_quantity_on_hand ELSE 0 END) > 0
+             THEN sum(CASE WHEN d_date >= DATE '2000-03-11'
+                           THEN inv_quantity_on_hand ELSE 0 END)
+                  * 1.000
+                  / sum(CASE WHEN d_date < DATE '2000-03-11'
+                             THEN inv_quantity_on_hand ELSE 0 END)
+             ELSE NULL END) BETWEEN 2.000 / 3.000 AND 3.000 / 2.000
+ORDER BY w_warehouse_name, i_item_id
+LIMIT 100
+""",
+    22: """
+SELECT i_product_name, i_brand, i_class, i_category,
+       avg(inv_quantity_on_hand) qoh
+FROM inventory, date_dim, item
+WHERE inv_date_sk = d_date_sk
+  AND inv_item_sk = i_item_sk
+  AND d_month_seq BETWEEN 1200 AND 1211
+GROUP BY ROLLUP (i_product_name, i_brand, i_class, i_category)
+ORDER BY qoh, i_product_name, i_brand, i_class, i_category
+LIMIT 100
+""",
+    29: """
+SELECT i_item_id, i_item_desc, s_store_id, s_store_name,
+       sum(ss_quantity) store_sales_quantity,
+       sum(sr_return_quantity) store_returns_quantity,
+       sum(cs_quantity) catalog_sales_quantity
+FROM store_sales, store_returns, catalog_sales, date_dim d1,
+     date_dim d2, date_dim d3, store, item
+WHERE d1.d_moy = 9 AND d1.d_year = 1999
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND i_item_sk = ss_item_sk
+  AND s_store_sk = ss_store_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_item_sk = sr_item_sk
+  AND ss_ticket_number = sr_ticket_number
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND d2.d_moy BETWEEN 9 AND 12 AND d2.d_year = 1999
+  AND sr_customer_sk = cs_bill_customer_sk
+  AND sr_item_sk = cs_item_sk
+  AND cs_sold_date_sk = d3.d_date_sk
+  AND d3.d_year IN (1999, 2000, 2001)
+GROUP BY i_item_id, i_item_desc, s_store_id, s_store_name
+ORDER BY i_item_id, i_item_desc, s_store_id, s_store_name
+LIMIT 100
+""",
+    33: """
+WITH ss AS (
+  SELECT i_manufact_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id),
+cs AS (
+  SELECT i_manufact_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id),
+ws AS (
+  SELECT i_manufact_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_manufact_id IN (SELECT i_manufact_id FROM item
+                          WHERE i_category = 'Electronics')
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 5
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_manufact_id)
+SELECT i_manufact_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss
+      UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_manufact_id
+ORDER BY total_sales, i_manufact_id
+LIMIT 100
+""",
+    38: """
+SELECT count(*) cnt
+FROM (SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM store_sales, date_dim, customer
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_customer_sk = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211
+      INTERSECT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM catalog_sales, date_dim, customer
+      WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+        AND catalog_sales.cs_bill_customer_sk
+            = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211
+      INTERSECT
+      SELECT DISTINCT c_last_name, c_first_name, d_date
+      FROM web_sales, date_dim, customer
+      WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+        AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+        AND d_month_seq BETWEEN 1200 AND 1211) hot_cust
+LIMIT 100
+""",
+    87: """
+SELECT count(*) cnt
+FROM ((SELECT DISTINCT c_last_name, c_first_name, d_date
+       FROM store_sales, date_dim, customer
+       WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+         AND store_sales.ss_customer_sk = customer.c_customer_sk
+         AND d_month_seq BETWEEN 1200 AND 1211)
+      EXCEPT
+      (SELECT DISTINCT c_last_name, c_first_name, d_date
+       FROM catalog_sales, date_dim, customer
+       WHERE catalog_sales.cs_sold_date_sk = date_dim.d_date_sk
+         AND catalog_sales.cs_bill_customer_sk
+             = customer.c_customer_sk
+         AND d_month_seq BETWEEN 1200 AND 1211)
+      EXCEPT
+      (SELECT DISTINCT c_last_name, c_first_name, d_date
+       FROM web_sales, date_dim, customer
+       WHERE web_sales.ws_sold_date_sk = date_dim.d_date_sk
+         AND web_sales.ws_bill_customer_sk = customer.c_customer_sk
+         AND d_month_seq BETWEEN 1200 AND 1211)) cool_cust
+""",
+    88: """
+SELECT *
+FROM (SELECT count(*) h8_30_to_9
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 8 AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = 4
+              AND household_demographics.hd_vehicle_count <= 3)
+             OR (household_demographics.hd_dep_count = 2
+                 AND household_demographics.hd_vehicle_count <= 1)
+             OR (household_demographics.hd_dep_count = 0
+                 AND household_demographics.hd_vehicle_count <= 2))
+        AND store.s_store_name = 'ese') s1,
+     (SELECT count(*) h9_to_9_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 9 AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = 4
+              AND household_demographics.hd_vehicle_count <= 3)
+             OR (household_demographics.hd_dep_count = 2
+                 AND household_demographics.hd_vehicle_count <= 1)
+             OR (household_demographics.hd_dep_count = 0
+                 AND household_demographics.hd_vehicle_count <= 2))
+        AND store.s_store_name = 'ese') s2,
+     (SELECT count(*) h9_30_to_10
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 9 AND time_dim.t_minute >= 30
+        AND ((household_demographics.hd_dep_count = 4
+              AND household_demographics.hd_vehicle_count <= 3)
+             OR (household_demographics.hd_dep_count = 2
+                 AND household_demographics.hd_vehicle_count <= 1)
+             OR (household_demographics.hd_dep_count = 0
+                 AND household_demographics.hd_vehicle_count <= 2))
+        AND store.s_store_name = 'ese') s3,
+     (SELECT count(*) h10_to_10_30
+      FROM store_sales, household_demographics, time_dim, store
+      WHERE ss_sold_time_sk = time_dim.t_time_sk
+        AND ss_hdemo_sk = household_demographics.hd_demo_sk
+        AND ss_store_sk = s_store_sk
+        AND time_dim.t_hour = 10 AND time_dim.t_minute < 30
+        AND ((household_demographics.hd_dep_count = 4
+              AND household_demographics.hd_vehicle_count <= 3)
+             OR (household_demographics.hd_dep_count = 2
+                 AND household_demographics.hd_vehicle_count <= 1)
+             OR (household_demographics.hd_dep_count = 0
+                 AND household_demographics.hd_vehicle_count <= 2))
+        AND store.s_store_name = 'ese') s4
+""",
+    90: """
+SELECT cast(amc AS double) / cast(pmc AS double) am_pm_ratio
+FROM (SELECT count(*) amc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+        AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        AND ws_web_page_sk = web_page.wp_web_page_sk
+        AND time_dim.t_hour BETWEEN 8 AND 9
+        AND household_demographics.hd_dep_count = 6
+        AND web_page.wp_char_count BETWEEN 5000 AND 5200) at1,
+     (SELECT count(*) pmc
+      FROM web_sales, household_demographics, time_dim, web_page
+      WHERE ws_sold_time_sk = time_dim.t_time_sk
+        AND ws_ship_hdemo_sk = household_demographics.hd_demo_sk
+        AND ws_web_page_sk = web_page.wp_web_page_sk
+        AND time_dim.t_hour BETWEEN 19 AND 20
+        AND household_demographics.hd_dep_count = 6
+        AND web_page.wp_char_count BETWEEN 5000 AND 5200) pt
+ORDER BY am_pm_ratio
+LIMIT 100
+""",
+    94: """
+SELECT count(DISTINCT ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN DATE '1999-02-01' AND DATE '1999-04-02'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND web_company_name = 'pri'
+  AND EXISTS (SELECT *
+              FROM web_sales ws2
+              WHERE ws1.ws_order_number = ws2.ws_order_number
+                AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+  AND NOT EXISTS (SELECT *
+                  FROM web_returns wr1
+                  WHERE ws1.ws_order_number = wr1.wr_order_number)
+ORDER BY count(DISTINCT ws_order_number)
+LIMIT 100
+""",
+    8: """
+SELECT s_store_name, sum(ss_net_profit) profit
+FROM store_sales, date_dim, store,
+     (SELECT ca_zip
+      FROM ((SELECT substr(ca_zip, 1, 5) ca_zip
+             FROM customer_address
+             WHERE substr(ca_zip, 1, 5) IN
+                   ('24250', '38800', '50440', '59170', '75369',
+                    '77697', '86136', '87494', '92635', '97000'))
+            INTERSECT
+            (SELECT ca_zip
+             FROM (SELECT substr(ca_zip, 1, 5) ca_zip, count(*) cnt
+                   FROM customer_address, customer
+                   WHERE ca_address_sk = c_current_addr_sk
+                     AND c_preferred_cust_flag = 'Y'
+                   GROUP BY substr(ca_zip, 1, 5)
+                   HAVING count(*) > 1) a1)) a2) v1
+WHERE ss_store_sk = s_store_sk
+  AND ss_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 1998
+  AND substr(s_zip, 1, 2) = substr(v1.ca_zip, 1, 2)
+GROUP BY s_store_name
+ORDER BY s_store_name
+LIMIT 100
+""",
+    18: """
+SELECT i_item_id, ca_country, ca_state, ca_county,
+       avg(cs_quantity) agg1, avg(cs_list_price) agg2,
+       avg(cs_coupon_amt) agg3, avg(cs_sales_price) agg4,
+       avg(cs_net_profit) agg5, avg(c_birth_year) agg6,
+       avg(cd1.cd_dep_count) agg7
+FROM catalog_sales, customer_demographics cd1,
+     customer_demographics cd2, customer, customer_address,
+     date_dim, item
+WHERE cs_sold_date_sk = d_date_sk
+  AND cs_item_sk = i_item_sk
+  AND cs_bill_cdemo_sk = cd1.cd_demo_sk
+  AND cs_bill_customer_sk = c_customer_sk
+  AND cd1.cd_gender = 'F'
+  AND cd1.cd_education_status = 'Unknown'
+  AND c_current_cdemo_sk = cd2.cd_demo_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND c_birth_month IN (1, 6, 8, 9, 12, 2)
+  AND d_year = 1998
+  AND ca_state IN ('MS', 'IN', 'ND', 'OK', 'NM', 'VA', 'MS')
+GROUP BY ROLLUP (i_item_id, ca_country, ca_state, ca_county)
+ORDER BY ca_country, ca_state, ca_county, i_item_id
+LIMIT 100
+""",
+    31: """
+WITH ss AS (
+  SELECT ca_county, d_qoy, d_year,
+         sum(ss_ext_sales_price) store_sales
+  FROM store_sales, date_dim, customer_address
+  WHERE ss_sold_date_sk = d_date_sk
+    AND ss_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year),
+ws AS (
+  SELECT ca_county, d_qoy, d_year,
+         sum(ws_ext_sales_price) web_sales
+  FROM web_sales, date_dim, customer_address
+  WHERE ws_sold_date_sk = d_date_sk
+    AND ws_bill_addr_sk = ca_address_sk
+  GROUP BY ca_county, d_qoy, d_year)
+SELECT ss1.ca_county, ss1.d_year,
+       ws2.web_sales / ws1.web_sales web_q1_q2_increase,
+       ss2.store_sales / ss1.store_sales store_q1_q2_increase,
+       ws3.web_sales / ws2.web_sales web_q2_q3_increase,
+       ss3.store_sales / ss2.store_sales store_q2_q3_increase
+FROM ss ss1, ss ss2, ss ss3, ws ws1, ws ws2, ws ws3
+WHERE ss1.d_qoy = 1 AND ss1.d_year = 2000
+  AND ss1.ca_county = ss2.ca_county
+  AND ss2.d_qoy = 2 AND ss2.d_year = 2000
+  AND ss2.ca_county = ss3.ca_county
+  AND ss3.d_qoy = 3 AND ss3.d_year = 2000
+  AND ss1.ca_county = ws1.ca_county
+  AND ws1.d_qoy = 1 AND ws1.d_year = 2000
+  AND ws1.ca_county = ws2.ca_county
+  AND ws2.d_qoy = 2 AND ws2.d_year = 2000
+  AND ws1.ca_county = ws3.ca_county
+  AND ws3.d_qoy = 3 AND ws3.d_year = 2000
+  AND CASE WHEN ws1.web_sales > 0
+           THEN ws2.web_sales / ws1.web_sales ELSE NULL END
+      > CASE WHEN ss1.store_sales > 0
+             THEN ss2.store_sales / ss1.store_sales ELSE NULL END
+  AND CASE WHEN ws2.web_sales > 0
+           THEN ws3.web_sales / ws2.web_sales ELSE NULL END
+      > CASE WHEN ss2.store_sales > 0
+             THEN ss3.store_sales / ss2.store_sales ELSE NULL END
+ORDER BY ss1.ca_county
+""",
+    34: """
+SELECT c_last_name, c_first_name, c_salutation,
+       c_preferred_cust_flag, ss_ticket_number, cnt
+FROM (SELECT ss_ticket_number, ss_customer_sk, count(*) cnt
+      FROM store_sales, date_dim, store, household_demographics
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk
+            = household_demographics.hd_demo_sk
+        AND (date_dim.d_dom BETWEEN 1 AND 3
+             OR date_dim.d_dom BETWEEN 25 AND 28)
+        AND (household_demographics.hd_buy_potential = '>10000'
+             OR household_demographics.hd_buy_potential = 'Unknown')
+        AND household_demographics.hd_vehicle_count > 0
+        AND (CASE WHEN household_demographics.hd_vehicle_count > 0
+                  THEN household_demographics.hd_dep_count * 1.000
+                       / household_demographics.hd_vehicle_count
+                  ELSE NULL END) > 1.2
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_county = 'Williamson County'
+      GROUP BY ss_ticket_number, ss_customer_sk) dn, customer
+WHERE ss_customer_sk = c_customer_sk
+  AND cnt BETWEEN 15 AND 20
+ORDER BY c_last_name, c_first_name, c_salutation,
+         c_preferred_cust_flag DESC, ss_ticket_number
+""",
+    36: """
+SELECT gross_margin, i_category, i_class, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN cls_grouping = 0
+                             THEN i_category END
+                    ORDER BY gross_margin) rank_within_parent
+FROM (SELECT sum(ss_net_profit) / sum(ss_ext_sales_price)
+                 gross_margin,
+             i_category, i_class,
+             grouping(i_category) + grouping(i_class) lochierarchy,
+             grouping(i_class) cls_grouping
+      FROM store_sales, date_dim d1, item, store
+      WHERE d1.d_year = 2001
+        AND d1.d_date_sk = ss_sold_date_sk
+        AND i_item_sk = ss_item_sk
+        AND s_store_sk = ss_store_sk
+        AND s_state IN ('TN', 'OH', 'TX', 'GA', 'IL')
+      GROUP BY ROLLUP (i_category, i_class)) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN i_category END,
+         rank_within_parent
+LIMIT 100
+""",
+    45: """
+SELECT ca_zip, ca_city, sum(ws_sales_price) total
+FROM web_sales, customer, customer_address, date_dim, item
+WHERE ws_bill_customer_sk = c_customer_sk
+  AND c_current_addr_sk = ca_address_sk
+  AND ws_item_sk = i_item_sk
+  AND (substr(ca_zip, 1, 5) IN
+           ('24250', '38800', '50440', '59170', '75369',
+            '77697', '86136', '87494', '92635', '97000')
+       OR i_item_id IN (SELECT i_item_id
+                        FROM item
+                        WHERE i_item_sk IN (2, 3, 5, 7, 11, 13,
+                                            17, 19, 23, 29)))
+  AND ws_sold_date_sk = d_date_sk
+  AND d_qoy = 2 AND d_year = 2001
+GROUP BY ca_zip, ca_city
+ORDER BY ca_zip, ca_city
+LIMIT 100
+""",
+    46: """
+SELECT c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, amt, profit
+FROM (SELECT ss_ticket_number, ss_customer_sk,
+             ca_city bought_city, sum(ss_coupon_amt) amt,
+             sum(ss_net_profit) profit
+      FROM store_sales, date_dim, store,
+           household_demographics, customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk
+            = household_demographics.hd_demo_sk
+        AND store_sales.ss_addr_sk
+            = customer_address.ca_address_sk
+        AND (household_demographics.hd_dep_count = 4
+             OR household_demographics.hd_vehicle_count = 3)
+        AND date_dim.d_dow IN (6, 0)
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_city IN ('Fairview', 'Midway')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, c_first_name, ca_city, bought_city,
+         ss_ticket_number
+LIMIT 100
+""",
+    56: """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blanched', 'beige'))
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blanched', 'beige'))
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_color IN ('slate', 'blanched', 'beige'))
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 2001 AND d_moy = 2
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss
+      UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY total_sales, i_item_id
+LIMIT 100
+""",
+    60: """
+WITH ss AS (
+  SELECT i_item_id, sum(ss_ext_sales_price) total_sales
+  FROM store_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND ss_item_sk = i_item_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND ss_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+cs AS (
+  SELECT i_item_id, sum(cs_ext_sales_price) total_sales
+  FROM catalog_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND cs_item_sk = i_item_sk
+    AND cs_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND cs_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id),
+ws AS (
+  SELECT i_item_id, sum(ws_ext_sales_price) total_sales
+  FROM web_sales, date_dim, customer_address, item
+  WHERE i_item_id IN (SELECT i_item_id FROM item
+                      WHERE i_category = 'Music')
+    AND ws_item_sk = i_item_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year = 1998 AND d_moy = 9
+    AND ws_bill_addr_sk = ca_address_sk
+    AND ca_gmt_offset = -5
+  GROUP BY i_item_id)
+SELECT i_item_id, sum(total_sales) total_sales
+FROM (SELECT * FROM ss
+      UNION ALL SELECT * FROM cs
+      UNION ALL SELECT * FROM ws) tmp1
+GROUP BY i_item_id
+ORDER BY i_item_id, total_sales
+LIMIT 100
+""",
+    68: """
+SELECT c_last_name, c_first_name, ca_city, bought_city,
+       ss_ticket_number, extended_price, extended_tax, list_price
+FROM (SELECT ss_ticket_number, ss_customer_sk,
+             ca_city bought_city,
+             sum(ss_ext_sales_price) extended_price,
+             sum(ss_ext_list_price) list_price,
+             sum(ss_ext_tax) extended_tax
+      FROM store_sales, date_dim, store,
+           household_demographics, customer_address
+      WHERE store_sales.ss_sold_date_sk = date_dim.d_date_sk
+        AND store_sales.ss_store_sk = store.s_store_sk
+        AND store_sales.ss_hdemo_sk
+            = household_demographics.hd_demo_sk
+        AND store_sales.ss_addr_sk
+            = customer_address.ca_address_sk
+        AND date_dim.d_dom BETWEEN 1 AND 2
+        AND (household_demographics.hd_dep_count = 5
+             OR household_demographics.hd_vehicle_count = 3)
+        AND date_dim.d_year IN (1999, 2000, 2001)
+        AND store.s_city IN ('Midway', 'Fairview')
+      GROUP BY ss_ticket_number, ss_customer_sk, ss_addr_sk,
+               ca_city) dn,
+     customer, customer_address current_addr
+WHERE ss_customer_sk = c_customer_sk
+  AND customer.c_current_addr_sk = current_addr.ca_address_sk
+  AND current_addr.ca_city <> bought_city
+ORDER BY c_last_name, ss_ticket_number
+LIMIT 100
+""",
+    76: """
+SELECT channel, col_name, d_year, d_qoy, i_category,
+       count(*) sales_cnt, sum(ext_sales_price) sales_amt
+FROM (SELECT 'store' AS channel, 'ss_store_sk' col_name,
+             d_year, d_qoy, i_category,
+             ss_ext_sales_price ext_sales_price
+      FROM store_sales, item, date_dim
+      WHERE ss_store_sk IS NULL
+        AND ss_sold_date_sk = d_date_sk
+        AND ss_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'web' AS channel, 'ws_ship_customer_sk' col_name,
+             d_year, d_qoy, i_category,
+             ws_ext_sales_price ext_sales_price
+      FROM web_sales, item, date_dim
+      WHERE ws_ship_customer_sk IS NULL
+        AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk = i_item_sk
+      UNION ALL
+      SELECT 'catalog' AS channel, 'cs_ship_addr_sk' col_name,
+             d_year, d_qoy, i_category,
+             cs_ext_sales_price ext_sales_price
+      FROM catalog_sales, item, date_dim
+      WHERE cs_ship_addr_sk IS NULL
+        AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk = i_item_sk) foo
+GROUP BY channel, col_name, d_year, d_qoy, i_category
+ORDER BY channel, col_name, d_year, d_qoy, i_category
+LIMIT 100
+""",
+    84: """
+SELECT c_customer_id customer_id,
+       c_last_name || ', ' || c_first_name customername
+FROM customer, customer_address, customer_demographics,
+     household_demographics, income_band, store_returns
+WHERE ca_city = 'Fairview'
+  AND c_current_addr_sk = ca_address_sk
+  AND ib_lower_bound >= 38128
+  AND ib_upper_bound <= 38128 + 50000
+  AND ib_income_band_sk = hd_income_band_sk
+  AND cd_demo_sk = sr_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+ORDER BY c_customer_id
+LIMIT 100
+""",
+    91: """
+SELECT cc_call_center_id call_center, cc_name call_center_name,
+       cc_manager manager, sum(cr_net_loss) returns_loss
+FROM call_center, catalog_returns, date_dim, customer,
+     customer_address, customer_demographics,
+     household_demographics
+WHERE cr_call_center_sk = cc_call_center_sk
+  AND cr_returned_date_sk = d_date_sk
+  AND cr_returning_customer_sk = c_customer_sk
+  AND cd_demo_sk = c_current_cdemo_sk
+  AND hd_demo_sk = c_current_hdemo_sk
+  AND ca_address_sk = c_current_addr_sk
+  AND d_year = 1998 AND d_moy = 11
+  AND ((cd_marital_status = 'M'
+        AND cd_education_status = 'Unknown')
+       OR (cd_marital_status = 'W'
+           AND cd_education_status = 'Advanced Degree'))
+  AND hd_buy_potential LIKE 'Unknown%'
+  AND ca_gmt_offset = -7
+GROUP BY cc_call_center_id, cc_name, cc_manager,
+         cd_marital_status, cd_education_status
+ORDER BY sum(cr_net_loss) DESC
+""",
 }

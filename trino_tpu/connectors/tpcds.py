@@ -76,12 +76,27 @@ _SCALE_POINTS = {
     "store":                 {0.01: 2, 1: 12, 10: 102, 100: 402},
     "promotion":             {0.01: 30, 1: 300, 10: 500, 100: 1000},
     "warehouse":             {0.01: 1, 1: 5, 10: 10, 100: 15},
+    "web_sales":             {0.01: 7198, 1: 719384, 10: 7197566,
+                              100: 71997522},
+    "web_returns":           {0.01: 718, 1: 71763, 10: 719217,
+                              100: 7197670},
+    "web_site":              {0.01: 2, 1: 30, 10: 42, 100: 54},
+    "web_page":              {0.01: 2, 1: 60, 10: 200, 100: 2040},
+    "inventory":             {0.01: 117450, 1: 11745000, 10: 133110000,
+                              100: 399330000},
+    "call_center":           {0.01: 2, 1: 6, 10: 24, 100: 30},
+    "catalog_page":          {0.01: 11718, 1: 11718, 10: 12000,
+                              100: 20400},
+    "reason":                {0.01: 35, 1: 35, 10: 60, 100: 70},
     "household_demographics": None,   # 7200 fixed
     "income_band":           None,    # 20 fixed
     "date_dim":              None,    # 73049 fixed
+    "time_dim":              None,    # 86400 fixed
+    "ship_mode":             None,    # 20 fixed
 }
 _FIXED_ROWS = {"household_demographics": 7200, "income_band": 20,
-               "date_dim": _N_DATES}
+               "date_dim": _N_DATES, "time_dim": 86400,
+               "ship_mode": 20}
 
 
 def table_rows(table: str, sf: float) -> int:
@@ -185,7 +200,18 @@ def _key_name_column(prefix: str, idx: np.ndarray, typ: Type) -> Column:
     return Column(typ, codes, None, dic)
 
 
-_SEED = {t: 1000 + 31 * i for i, t in enumerate(sorted(_SCALE_POINTS))}
+# seed order is FROZEN for the original 14 tables (reordering would
+# silently regenerate every dataset); new tables append after them
+_SEED_ORDER = [
+    "catalog_returns", "catalog_sales", "customer", "customer_address",
+    "customer_demographics", "date_dim", "household_demographics",
+    "income_band", "item", "promotion", "store", "store_returns",
+    "store_sales", "warehouse",
+    # round-4 additions
+    "web_sales", "web_returns", "web_site", "web_page", "inventory",
+    "time_dim", "reason", "ship_mode", "call_center", "catalog_page",
+]
+_SEED = {t: 1000 + 31 * i for i, t in enumerate(_SEED_ORDER)}
 
 
 def _fk(seed: int, idx: np.ndarray, n_ref: int,
@@ -392,6 +418,29 @@ class TpcdsConnector(Connector):
         if "c_birth_year" in need:
             cols["c_birth_year"] = Column(
                 INTEGER, _randint(S + 10, idx, 1924, 1992), None)
+        if "c_birth_month" in need:
+            cols["c_birth_month"] = Column(
+                INTEGER, _randint(S + 12, idx, 1, 12), None)
+        if "c_birth_day" in need:
+            cols["c_birth_day"] = Column(
+                INTEGER, _randint(S + 13, idx, 1, 28), None)
+        if "c_preferred_cust_flag" in need:
+            cols["c_preferred_cust_flag"] = _strings(
+                ["N", "Y"],
+                (_u64(S + 14, idx) % np.uint64(2)).astype(np.int32),
+                VarcharType(1))
+        if "c_salutation" in need:
+            sal = ["Mr.", "Mrs.", "Ms.", "Miss", "Dr.", "Sir"]
+            cols["c_salutation"] = _strings(
+                sal, (_u64(S + 15, idx) % np.uint64(6)).astype(np.int32),
+                VarcharType(10))
+        if "c_email_address" in need:
+            cols["c_email_address"] = _key_name_column(
+                "Customer@example.", idx, VarcharType(50))
+        if "c_last_review_date_sk" in need:
+            cols["c_last_review_date_sk"] = Column(
+                BIGINT, _randint(S + 16, idx, _date_sk(1999, 1, 1),
+                                 _date_sk(2002, 12, 31)), None)
         if "c_birth_country" in need:
             from .tpch import NATIONS
             vals = [n0.upper() for n0, _ in NATIONS]
@@ -429,6 +478,29 @@ class TpcdsConnector(Connector):
             VarcharType(2))
         cols["ca_country"] = _strings(
             ["United States"], np.zeros(n, np.int32), VarcharType(20))
+        cols["ca_county"] = _strings(
+            ["Williamson County", "Ziebach County", "Walker County",
+             "Daviess County", "Barrow County", "Franklin Parish",
+             "Luce County", "Richland County", "Furnas County",
+             "Maverick County"],
+            (_u64(S + 8, idx) % np.uint64(10)).astype(np.int32),
+            VarcharType(30))
+        cols["ca_gmt_offset"] = Column(
+            DOUBLE, -5.0 - (_u64(S + 9, idx)
+                            % np.uint64(4)).astype(np.int64), None)
+        cols["ca_street_type"] = _strings(
+            _STREET_TYPES,
+            (_u64(S + 10, idx)
+             % np.uint64(len(_STREET_TYPES))).astype(np.int32),
+            VarcharType(15))
+        cols["ca_suite_number"] = _strings(
+            [f"Suite {v}" for v in range(0, 100, 10)],
+            (_u64(S + 11, idx) % np.uint64(10)).astype(np.int32),
+            VarcharType(10))
+        cols["ca_location_type"] = _strings(
+            ["apartment", "condo", "single family"],
+            (_u64(S + 12, idx) % np.uint64(3)).astype(np.int32),
+            VarcharType(20))
         return self._finish(cols, n, columns)
 
     def _customer_demographics(self, idx, sf, columns) -> Batch:
@@ -453,6 +525,10 @@ class TpcdsConnector(Connector):
         cols["cd_credit_rating"] = _strings(
             _CREDIT, ((k4 // 20) % 4).astype(np.int32), VarcharType(10))
         cols["cd_dep_count"] = Column(BIGINT, (k4 // 80) % 7, None)
+        cols["cd_dep_employed_count"] = Column(
+            BIGINT, (k4 // 560) % 7, None)
+        cols["cd_dep_college_count"] = Column(
+            BIGINT, (k4 // 3920) % 7, None)
         return self._finish(cols, n, columns)
 
     def _household_demographics(self, idx, sf, columns) -> Batch:
@@ -528,14 +604,200 @@ class TpcdsConnector(Connector):
         return self._finish(cols, n, columns)
 
     def _warehouse(self, idx, sf, columns) -> Batch:
+        S = _SEED["warehouse"]
         n = len(idx)
         cols = {
             "w_warehouse_sk": Column(BIGINT, idx.copy(), None),
             "w_warehouse_name": _key_name_column("Warehouse#", idx,
                                                  VarcharType(20)),
             "w_warehouse_sq_ft": Column(
-                BIGINT, _randint(_SEED["warehouse"] + 2, idx, 50000,
-                                 1000000), None),
+                BIGINT, _randint(S + 2, idx, 50000, 1000000), None),
+            "w_city": _strings(
+                [c.replace("_", " ") for c in _CITIES[:20]],
+                (_u64(S + 3, idx) % np.uint64(20)).astype(np.int32),
+                VarcharType(60)),
+            "w_county": _strings(
+                ["Williamson County", "Ziebach County", "Walker County",
+                 "Daviess County", "Barrow County"],
+                (_u64(S + 4, idx) % np.uint64(5)).astype(np.int32),
+                VarcharType(30)),
+            "w_state": _strings(
+                ["TN", "OH", "TX", "GA", "IL"],
+                (_u64(S + 5, idx) % np.uint64(5)).astype(np.int32),
+                VarcharType(2)),
+            "w_country": _strings(
+                ["United States"], np.zeros(n, np.int32),
+                VarcharType(20)),
+        }
+        return self._finish(cols, n, columns)
+
+    def _time_dim(self, idx, sf, columns) -> Batch:
+        """One row per second of day: sk 0..86399 (spec time_dim)."""
+        n = len(idx)
+        t = idx - 1                       # 0-based seconds
+        hour = t // 3600
+        minute = (t // 60) % 60
+        second = t % 60
+        cols: Dict[str, Column] = {
+            "t_time_sk": Column(BIGINT, t.copy(), None),
+            "t_time": Column(BIGINT, t.copy(), None),
+            "t_hour": Column(BIGINT, hour, None),
+            "t_minute": Column(BIGINT, minute, None),
+            "t_second": Column(BIGINT, second, None),
+        }
+        cols["t_am_pm"] = _strings(
+            ["AM", "PM"], (hour >= 12).astype(np.int32), VarcharType(2))
+        meal = np.select(
+            [(hour >= 6) & (hour <= 8), (hour >= 11) & (hour <= 13),
+             (hour >= 17) & (hour <= 19)],
+            [1, 2, 3], default=0).astype(np.int32)
+        cols["t_meal_time"] = Column(
+            VarcharType(20),
+            meal,
+            meal > 0,
+            StringDictionary(np.asarray(
+                ["", "breakfast", "lunch", "dinner"], dtype=object)))
+        return self._finish(cols, n, columns)
+
+    def _reason(self, idx, sf, columns) -> Batch:
+        n = len(idx)
+        descs = ["Package was damaged", "Stopped working",
+                 "Did not get it on time", "Not the product that was "
+                 "ordred", "Parts missing", "Does not work with a "
+                 "product that I have", "Gift exchange", "Did not like "
+                 "the color", "Did not like the model", "Did not like "
+                 "the make", "Did not fit", "Wrong size", "Lost my job",
+                 "unauthoized purchase", "Found a better price in a "
+                 "store", "Found a better extension in a store",
+                 "No service location in my area", "Duplicate purchase",
+                 "Its the best", "Did not like the warranty",
+                 "reason 21", "reason 22", "reason 23", "reason 24",
+                 "reason 25", "reason 26", "reason 27", "reason 28",
+                 "reason 29", "reason 30", "reason 31", "reason 32",
+                 "reason 33", "reason 34", "reason 35"]
+        cols = {
+            "r_reason_sk": Column(BIGINT, idx.copy(), None),
+            "r_reason_id": _key_name_column("AAAAAAAA", idx,
+                                            VarcharType(16)),
+            "r_reason_desc": _strings(
+                descs, ((idx - 1) % len(descs)).astype(np.int32),
+                VarcharType(100)),
+        }
+        return self._finish(cols, n, columns)
+
+    def _ship_mode(self, idx, sf, columns) -> Batch:
+        n = len(idx)
+        types = ["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR",
+                 "TWO DAY"]
+        carriers = ["UPS", "FEDEX", "AIRBORNE", "USPS", "DHL", "TBS",
+                    "ZHOU", "ZOUROS", "MSC", "LATVIAN", "DIAMOND",
+                    "BARIAN", "ALLIANCE", "ORIENTAL", "BOXBUNDLES",
+                    "GREAT EASTERN", "HARMSTORF", "PRIVATECARRIER",
+                    "GERMA", "RUPEKSA"]
+        cols = {
+            "sm_ship_mode_sk": Column(BIGINT, idx.copy(), None),
+            "sm_ship_mode_id": _key_name_column("AAAAAAAA", idx,
+                                                VarcharType(16)),
+            "sm_type": _strings(
+                types, ((idx - 1) % 5).astype(np.int32), VarcharType(30)),
+            "sm_carrier": _strings(
+                carriers, ((idx - 1) % 20).astype(np.int32),
+                VarcharType(20)),
+            "sm_code": _strings(
+                ["AIR", "SURFACE", "SEA", "LIBRARY"],
+                ((idx - 1) % 4).astype(np.int32), VarcharType(10)),
+        }
+        return self._finish(cols, n, columns)
+
+    def _call_center(self, idx, sf, columns) -> Batch:
+        S = _SEED["call_center"]
+        n = len(idx)
+        names = ["NY Metro", "Mid Atlantic", "Mideast", "North Midwest",
+                 "Pacific Northwest", "Southwest", "California",
+                 "Hawaii/Alaska", "Northeast", "Southeast"]
+        cols = {
+            "cc_call_center_sk": Column(BIGINT, idx.copy(), None),
+            "cc_call_center_id": _key_name_column("AAAAAAAA", idx,
+                                                  VarcharType(16)),
+            "cc_name": _strings(
+                names, ((idx - 1) % len(names)).astype(np.int32),
+                VarcharType(50)),
+            "cc_manager": _word_column(S + 2, idx, _P_NAMES, 2,
+                                       VarcharType(40)),
+            "cc_county": _strings(
+                ["Williamson County", "Ziebach County", "Walker County",
+                 "Daviess County", "Barrow County"],
+                (_u64(S + 3, idx) % np.uint64(5)).astype(np.int32),
+                VarcharType(30)),
+        }
+        return self._finish(cols, n, columns)
+
+    def _catalog_page(self, idx, sf, columns) -> Batch:
+        n = len(idx)
+        cols = {
+            "cp_catalog_page_sk": Column(BIGINT, idx.copy(), None),
+            "cp_catalog_page_id": _key_name_column("AAAAAAAA", idx,
+                                                   VarcharType(16)),
+            "cp_catalog_number": Column(
+                BIGINT, (idx - 1) // 108 + 1, None),
+            "cp_catalog_page_number": Column(
+                BIGINT, (idx - 1) % 108 + 1, None),
+        }
+        return self._finish(cols, n, columns)
+
+    def _web_site(self, idx, sf, columns) -> Batch:
+        n = len(idx)
+        names = [f"site_{i}" for i in range(40)]
+        cols = {
+            "web_site_sk": Column(BIGINT, idx.copy(), None),
+            "web_site_id": _key_name_column("AAAAAAAA", idx,
+                                            VarcharType(16)),
+            "web_name": _strings(
+                names, ((idx - 1) % len(names)).astype(np.int32),
+                VarcharType(50)),
+            "web_company_name": _strings(
+                ["pri", "ought", "able", "ese", "anti", "cally"],
+                ((idx - 1) % 6).astype(np.int32), VarcharType(50)),
+        }
+        return self._finish(cols, n, columns)
+
+    def _web_page(self, idx, sf, columns) -> Batch:
+        S = _SEED["web_page"]
+        n = len(idx)
+        cols = {
+            "wp_web_page_sk": Column(BIGINT, idx.copy(), None),
+            "wp_web_page_id": _key_name_column("AAAAAAAA", idx,
+                                               VarcharType(16)),
+            "wp_char_count": Column(
+                BIGINT, _randint(S + 2, idx, 100, 8000), None),
+        }
+        return self._finish(cols, n, columns)
+
+    def _inventory(self, idx, sf, columns) -> Batch:
+        """Weekly stock levels: key decodes to (week, item, warehouse);
+        inv_date_sk steps 7 days from 1998-01-01 (spec: one snapshot
+        per week over the sales window)."""
+        S = _SEED["inventory"]
+        n = len(idx)
+        n_item = table_rows("item", sf)
+        n_wh = table_rows("warehouse", sf)
+        k = idx - 1
+        # week varies FASTEST so every scale factor covers the whole
+        # 5-year window (261 weekly snapshots) — with item-fastest
+        # decode, small scales stop in early 1999 and date-filtered
+        # queries (q37/q82/q21/q22) go empty at tiny
+        week = k % 261
+        rest = k // 261
+        item = (rest % n_item) + 1
+        wh = (rest // n_item) % n_wh + 1
+        cols = {
+            "inv_date_sk": Column(
+                BIGINT, _date_sk(1998, 1, 1) + 7 * week, None),
+            "inv_item_sk": Column(BIGINT, item, None),
+            "inv_warehouse_sk": Column(BIGINT, wh, None),
+            "inv_quantity_on_hand": Column(
+                BIGINT, _randint(S + 2, idx, 0, 1000),
+                _uniform(S + 3, idx) >= 0.05),
         }
         return self._finish(cols, n, columns)
 
@@ -557,6 +819,9 @@ class TpcdsConnector(Connector):
         cols["ss_sold_date_sk"] = Column(
             BIGINT, _randint(S + 3, ticket, _SALES_SK_LO, _SALES_SK_HI),
             _uniform(S + 103, ticket) >= 0.02)
+        if "ss_sold_time_sk" in need:
+            cols["ss_sold_time_sk"] = Column(
+                BIGINT, _randint(S + 33, ticket, 28800, 75600), None)
         for cname, ref, s, nf in (
                 ("ss_customer_sk", table_rows("customer", sf), 4, 0.02),
                 ("ss_cdemo_sk",
@@ -589,6 +854,11 @@ class TpcdsConnector(Connector):
         if "ss_ext_wholesale_cost" in need:
             cols["ss_ext_wholesale_cost"] = Column(
                 DOUBLE, np.round(whole * qty, 2), None)
+        if "ss_ext_tax" in need:
+            cols["ss_ext_tax"] = Column(
+                DOUBLE, np.round(sp * qty * 0.01
+                                 * _randint(S + 16, idx, 0, 9), 2),
+                None)
         cols["ss_coupon_amt"] = Column(
             DOUBLE,
             np.where(_uniform(S + 14, idx) < 0.2,
@@ -636,6 +906,22 @@ class TpcdsConnector(Connector):
         if "sr_net_loss" in need:
             cols["sr_net_loss"] = Column(
                 DOUBLE, _price(S + 6, idx, 0.5, 150.0), None)
+        if "sr_reason_sk" in need:
+            k, v = _fk(S + 7, idx, table_rows("reason", sf), 0.02)
+            cols["sr_reason_sk"] = Column(BIGINT, k, v)
+        if "sr_return_time_sk" in need:
+            cols["sr_return_time_sk"] = Column(
+                BIGINT, _randint(S + 8, idx, 28800, 61200), None)
+        for cname, s in (("sr_fee", 9), ("sr_refunded_cash", 10),
+                         ("sr_reversed_charge", 11),
+                         ("sr_store_credit", 12)):
+            if cname in need:
+                cols[cname] = Column(
+                    DOUBLE, _price(S + s, idx, 0.0, 100.0), None)
+        if "sr_cdemo_sk" in need:
+            k, v = _fk(S + 13, idx,
+                       table_rows("customer_demographics", sf), 0.02)
+            cols["sr_cdemo_sk"] = Column(BIGINT, k, v)
         return self._finish(cols, n, columns)
 
     def _catalog_sales(self, idx, sf, columns) -> Batch:
@@ -648,12 +934,30 @@ class TpcdsConnector(Connector):
         cols["cs_item_sk"] = Column(
             BIGINT, 1 + (_u64(S + 2, idx) % np.uint64(n_item)).astype(
                 np.int64), None)
-        cols["cs_sold_date_sk"] = Column(
-            BIGINT, _randint(S + 3, idx, _SALES_SK_LO, _SALES_SK_HI),
-            None)
+        sold = _randint(S + 3, idx, _SALES_SK_LO, _SALES_SK_HI)
+        cols["cs_sold_date_sk"] = Column(BIGINT, sold, None)
+        if "cs_ship_date_sk" in need:
+            cols["cs_ship_date_sk"] = Column(
+                BIGINT, sold + _randint(S + 30, idx, 1, 120), None)
+        if "cs_sold_time_sk" in need:
+            cols["cs_sold_time_sk"] = Column(
+                BIGINT, _randint(S + 31, idx, 0, 86399), None)
         for cname, ref, s in (
                 ("cs_bill_customer_sk", table_rows("customer", sf), 4),
                 ("cs_ship_customer_sk", table_rows("customer", sf), 5),
+                ("cs_bill_cdemo_sk",
+                 table_rows("customer_demographics", sf), 12),
+                ("cs_bill_hdemo_sk",
+                 table_rows("household_demographics", sf), 13),
+                ("cs_bill_addr_sk",
+                 table_rows("customer_address", sf), 14),
+                ("cs_ship_addr_sk",
+                 table_rows("customer_address", sf), 15),
+                ("cs_call_center_sk", table_rows("call_center", sf), 16),
+                ("cs_catalog_page_sk",
+                 table_rows("catalog_page", sf), 17),
+                ("cs_ship_mode_sk", table_rows("ship_mode", sf), 18),
+                ("cs_promo_sk", table_rows("promotion", sf), 19),
                 ("cs_warehouse_sk", table_rows("warehouse", sf), 6)):
             k, v = _fk(S + s, idx, ref, 0.02)
             cols[cname] = Column(BIGINT, k, v)
@@ -663,17 +967,145 @@ class TpcdsConnector(Connector):
         cols["cs_list_price"] = Column(DOUBLE, lp, None)
         cols["cs_ext_list_price"] = Column(
             DOUBLE, np.round(lp * qty, 2), None)
-        if "cs_sales_price" in need or "cs_ext_sales_price" in need:
+        if need & {"cs_sales_price", "cs_ext_sales_price",
+                   "cs_ext_discount_amt", "cs_net_paid"}:
             sp = np.round(lp * (0.2 + 0.8 * _uniform(S + 9, idx)), 2)
             cols["cs_sales_price"] = Column(DOUBLE, sp, None)
             cols["cs_ext_sales_price"] = Column(
                 DOUBLE, np.round(sp * qty, 2), None)
-        if "cs_wholesale_cost" in need:
-            cols["cs_wholesale_cost"] = Column(
-                DOUBLE, _price(S + 10, idx, 1.0, 100.0), None)
+            if "cs_ext_discount_amt" in need:
+                cols["cs_ext_discount_amt"] = Column(
+                    DOUBLE, np.round((lp - sp) * qty, 2), None)
+            if "cs_net_paid" in need:
+                cols["cs_net_paid"] = Column(
+                    DOUBLE, np.round(sp * qty, 2), None)
+        if need & {"cs_wholesale_cost", "cs_ext_wholesale_cost"}:
+            whole = _price(S + 10, idx, 1.0, 100.0)
+            if "cs_wholesale_cost" in need:
+                cols["cs_wholesale_cost"] = Column(DOUBLE, whole, None)
+            if "cs_ext_wholesale_cost" in need:
+                cols["cs_ext_wholesale_cost"] = Column(
+                    DOUBLE, np.round(whole * qty, 2), None)
+        if "cs_ext_ship_cost" in need:
+            cols["cs_ext_ship_cost"] = Column(
+                DOUBLE, _price(S + 20, idx, 0.0, 50.0), None)
+        if "cs_coupon_amt" in need:
+            cols["cs_coupon_amt"] = Column(
+                DOUBLE,
+                np.where(_uniform(S + 21, idx) < 0.2,
+                         _price(S + 22, idx, 0.0, 500.0), 0.0), None)
         if "cs_net_profit" in need:
             cols["cs_net_profit"] = Column(
                 DOUBLE, _price(S + 11, idx, -500.0, 500.0), None)
+        return self._finish(cols, n, columns)
+
+    def _web_sales(self, idx, sf, columns) -> Batch:
+        S = _SEED["web_sales"]
+        need = set(columns)
+        n = len(idx)
+        n_item = table_rows("item", sf)
+        cols: Dict[str, Column] = {}
+        cols["ws_order_number"] = Column(BIGINT, idx.copy(), None)
+        cols["ws_item_sk"] = Column(
+            BIGINT, 1 + (_u64(S + 2, idx) % np.uint64(n_item)).astype(
+                np.int64), None)
+        sold = _randint(S + 3, idx, _SALES_SK_LO, _SALES_SK_HI)
+        cols["ws_sold_date_sk"] = Column(BIGINT, sold, None)
+        if "ws_ship_date_sk" in need:
+            cols["ws_ship_date_sk"] = Column(
+                BIGINT, sold + _randint(S + 30, idx, 1, 120), None)
+        if "ws_sold_time_sk" in need:
+            cols["ws_sold_time_sk"] = Column(
+                BIGINT, _randint(S + 31, idx, 0, 86399), None)
+        for cname, ref, s in (
+                ("ws_bill_customer_sk", table_rows("customer", sf), 4),
+                ("ws_ship_customer_sk", table_rows("customer", sf), 5),
+                ("ws_bill_cdemo_sk",
+                 table_rows("customer_demographics", sf), 12),
+                ("ws_bill_hdemo_sk",
+                 table_rows("household_demographics", sf), 13),
+                ("ws_ship_hdemo_sk",
+                 table_rows("household_demographics", sf), 21),
+                ("ws_bill_addr_sk",
+                 table_rows("customer_address", sf), 14),
+                ("ws_ship_addr_sk",
+                 table_rows("customer_address", sf), 15),
+                ("ws_warehouse_sk", table_rows("warehouse", sf), 6),
+                ("ws_web_page_sk", table_rows("web_page", sf), 16),
+                ("ws_web_site_sk", table_rows("web_site", sf), 17),
+                ("ws_ship_mode_sk", table_rows("ship_mode", sf), 18),
+                ("ws_promo_sk", table_rows("promotion", sf), 19)):
+            k, v = _fk(S + s, idx, ref, 0.02)
+            cols[cname] = Column(BIGINT, k, v)
+        qty = _randint(S + 7, idx, 1, 100)
+        lp = _price(S + 8, idx, 1.0, 200.0)
+        whole = _price(S + 10, idx, 1.0, 100.0)
+        sp = np.round(lp * (0.2 + 0.8 * _uniform(S + 9, idx)), 2)
+        cols["ws_quantity"] = Column(BIGINT, qty, None)
+        cols["ws_list_price"] = Column(DOUBLE, lp, None)
+        cols["ws_sales_price"] = Column(DOUBLE, sp, None)
+        cols["ws_wholesale_cost"] = Column(DOUBLE, whole, None)
+        cols["ws_ext_list_price"] = Column(
+            DOUBLE, np.round(lp * qty, 2), None)
+        cols["ws_ext_sales_price"] = Column(
+            DOUBLE, np.round(sp * qty, 2), None)
+        if "ws_ext_wholesale_cost" in need:
+            cols["ws_ext_wholesale_cost"] = Column(
+                DOUBLE, np.round(whole * qty, 2), None)
+        if "ws_ext_discount_amt" in need:
+            cols["ws_ext_discount_amt"] = Column(
+                DOUBLE, np.round((lp - sp) * qty, 2), None)
+        if "ws_ext_ship_cost" in need:
+            cols["ws_ext_ship_cost"] = Column(
+                DOUBLE, _price(S + 20, idx, 0.0, 50.0), None)
+        if "ws_net_paid" in need:
+            cols["ws_net_paid"] = Column(
+                DOUBLE, np.round(sp * qty, 2), None)
+        if "ws_net_profit" in need:
+            cols["ws_net_profit"] = Column(
+                DOUBLE, np.round((sp - whole) * qty, 2), None)
+        return self._finish(cols, n, columns)
+
+    def _web_returns(self, idx, sf, columns) -> Batch:
+        """Each return references a real web_sales row (strided)."""
+        S = _SEED["web_returns"]
+        need = set(columns)
+        n = len(idx)
+        ws_rows = table_rows("web_sales", sf)
+        wr_rows = table_rows("web_returns", sf)
+        ws_idx = 1 + (idx - 1) * ws_rows // wr_rows
+        Sws = _SEED["web_sales"]
+        n_item = table_rows("item", sf)
+        cols: Dict[str, Column] = {}
+        cols["wr_item_sk"] = Column(
+            BIGINT, 1 + (_u64(Sws + 2, ws_idx)
+                         % np.uint64(n_item)).astype(np.int64), None)
+        cols["wr_order_number"] = Column(BIGINT, ws_idx, None)
+        cols["wr_returned_date_sk"] = Column(
+            BIGINT, _randint(S + 2, idx, _SALES_SK_LO, _SALES_SK_HI),
+            None)
+        for cname, sref in (("wr_refunded_customer_sk", 4),
+                            ("wr_returning_customer_sk", 4)):
+            k, v = _fk(Sws + sref, ws_idx, table_rows("customer", sf),
+                       0.02)
+            cols[cname] = Column(BIGINT, k, v)
+        if "wr_web_page_sk" in need:
+            k, v = _fk(Sws + 16, ws_idx, table_rows("web_page", sf),
+                       0.02)
+            cols["wr_web_page_sk"] = Column(BIGINT, k, v)
+        if "wr_reason_sk" in need:
+            k, v = _fk(S + 5, idx, table_rows("reason", sf), 0.02)
+            cols["wr_reason_sk"] = Column(BIGINT, k, v)
+        qty = _randint(S + 6, idx, 1, 20)
+        cols["wr_return_quantity"] = Column(BIGINT, qty, None)
+        cols["wr_return_amt"] = Column(
+            DOUBLE, _price(S + 7, idx, 1.0, 300.0), None)
+        if "wr_net_loss" in need:
+            cols["wr_net_loss"] = Column(
+                DOUBLE, _price(S + 8, idx, 0.5, 150.0), None)
+        if "wr_refunded_cash" in need:
+            cols["wr_refunded_cash"] = Column(
+                DOUBLE, _price(S + 9, idx, 0.0, 200.0), None)
         return self._finish(cols, n, columns)
 
     def _catalog_returns(self, idx, sf, columns) -> Batch:
@@ -700,6 +1132,26 @@ class TpcdsConnector(Connector):
             DOUBLE, _price(S + 5, idx, 0.0, 100.0), None)
         cols["cr_return_quantity"] = Column(
             BIGINT, _randint(S + 6, idx, 1, 20), None)
+        need = set(columns)
+        if "cr_return_amount" in need:
+            cols["cr_return_amount"] = Column(
+                DOUBLE, _price(S + 7, idx, 1.0, 300.0), None)
+        if "cr_net_loss" in need:
+            cols["cr_net_loss"] = Column(
+                DOUBLE, _price(S + 8, idx, 0.5, 150.0), None)
+        if "cr_returning_customer_sk" in need:
+            k, v = _fk(S + 9, idx, table_rows("customer", sf), 0.02)
+            cols["cr_returning_customer_sk"] = Column(BIGINT, k, v)
+        if "cr_call_center_sk" in need:
+            k, v = _fk(S + 10, idx, table_rows("call_center", sf), 0.02)
+            cols["cr_call_center_sk"] = Column(BIGINT, k, v)
+        if "cr_catalog_page_sk" in need:
+            k, v = _fk(S + 11, idx, table_rows("catalog_page", sf),
+                       0.02)
+            cols["cr_catalog_page_sk"] = Column(BIGINT, k, v)
+        if "cr_reason_sk" in need:
+            k, v = _fk(S + 12, idx, table_rows("reason", sf), 0.02)
+            cols["cr_reason_sk"] = Column(BIGINT, k, v)
         return self._finish(cols, n, columns)
 
 
@@ -736,18 +1188,27 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("c_first_sales_date_sk", BIGINT),
         _cm("c_first_shipto_date_sk", BIGINT),
         _cm("c_first_name", _V(20)), _cm("c_last_name", _V(30)),
-        _cm("c_birth_year", INTEGER), _cm("c_birth_country", _V(20))],
+        _cm("c_birth_year", INTEGER), _cm("c_birth_month", INTEGER),
+        _cm("c_birth_day", INTEGER), _cm("c_birth_country", _V(20)),
+        _cm("c_preferred_cust_flag", _V(1)), _cm("c_salutation", _V(10)),
+        _cm("c_email_address", _V(50)),
+        _cm("c_last_review_date_sk", BIGINT)],
     "customer_address": [
         _cm("ca_address_sk", BIGINT), _cm("ca_street_number", _V(10)),
         _cm("ca_street_name", _V(60)), _cm("ca_city", _V(60)),
         _cm("ca_zip", _V(10)), _cm("ca_state", _V(2)),
-        _cm("ca_country", _V(20))],
+        _cm("ca_country", _V(20)), _cm("ca_county", _V(30)),
+        _cm("ca_gmt_offset", DOUBLE), _cm("ca_street_type", _V(15)),
+        _cm("ca_suite_number", _V(10)),
+        _cm("ca_location_type", _V(20))],
     "customer_demographics": [
         _cm("cd_demo_sk", BIGINT), _cm("cd_gender", _V(1)),
         _cm("cd_marital_status", _V(1)),
         _cm("cd_education_status", _V(20)),
         _cm("cd_purchase_estimate", BIGINT),
-        _cm("cd_credit_rating", _V(10)), _cm("cd_dep_count", BIGINT)],
+        _cm("cd_credit_rating", _V(10)), _cm("cd_dep_count", BIGINT),
+        _cm("cd_dep_employed_count", BIGINT),
+        _cm("cd_dep_college_count", BIGINT)],
     "household_demographics": [
         _cm("hd_demo_sk", BIGINT), _cm("hd_income_band_sk", BIGINT),
         _cm("hd_buy_potential", _V(15)), _cm("hd_dep_count", BIGINT),
@@ -768,9 +1229,12 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("p_channel_catalog", _V(1)), _cm("p_cost", DOUBLE)],
     "warehouse": [
         _cm("w_warehouse_sk", BIGINT), _cm("w_warehouse_name", _V(20)),
-        _cm("w_warehouse_sq_ft", BIGINT)],
+        _cm("w_warehouse_sq_ft", BIGINT), _cm("w_city", _V(60)),
+        _cm("w_county", _V(30)), _cm("w_state", _V(2)),
+        _cm("w_country", _V(20))],
     "store_sales": [
-        _cm("ss_sold_date_sk", BIGINT), _cm("ss_item_sk", BIGINT),
+        _cm("ss_sold_date_sk", BIGINT), _cm("ss_sold_time_sk", BIGINT),
+        _cm("ss_item_sk", BIGINT),
         _cm("ss_customer_sk", BIGINT), _cm("ss_cdemo_sk", BIGINT),
         _cm("ss_hdemo_sk", BIGINT), _cm("ss_addr_sk", BIGINT),
         _cm("ss_store_sk", BIGINT), _cm("ss_promo_sk", BIGINT),
@@ -781,29 +1245,112 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("ss_ext_list_price", DOUBLE),
         _cm("ss_ext_discount_amt", DOUBLE),
         _cm("ss_ext_wholesale_cost", DOUBLE),
+        _cm("ss_ext_tax", DOUBLE),
         _cm("ss_coupon_amt", DOUBLE), _cm("ss_net_paid", DOUBLE),
         _cm("ss_net_profit", DOUBLE)],
     "store_returns": [
         _cm("sr_item_sk", BIGINT), _cm("sr_ticket_number", BIGINT),
         _cm("sr_returned_date_sk", BIGINT),
-        _cm("sr_customer_sk", BIGINT), _cm("sr_store_sk", BIGINT),
+        _cm("sr_return_time_sk", BIGINT),
+        _cm("sr_customer_sk", BIGINT), _cm("sr_cdemo_sk", BIGINT),
+        _cm("sr_store_sk", BIGINT), _cm("sr_reason_sk", BIGINT),
         _cm("sr_return_quantity", BIGINT),
-        _cm("sr_return_amt", DOUBLE), _cm("sr_net_loss", DOUBLE)],
+        _cm("sr_return_amt", DOUBLE), _cm("sr_net_loss", DOUBLE),
+        _cm("sr_fee", DOUBLE), _cm("sr_refunded_cash", DOUBLE),
+        _cm("sr_reversed_charge", DOUBLE),
+        _cm("sr_store_credit", DOUBLE)],
     "catalog_sales": [
-        _cm("cs_sold_date_sk", BIGINT), _cm("cs_item_sk", BIGINT),
+        _cm("cs_sold_date_sk", BIGINT), _cm("cs_sold_time_sk", BIGINT),
+        _cm("cs_ship_date_sk", BIGINT), _cm("cs_item_sk", BIGINT),
         _cm("cs_order_number", BIGINT),
         _cm("cs_bill_customer_sk", BIGINT),
         _cm("cs_ship_customer_sk", BIGINT),
+        _cm("cs_bill_cdemo_sk", BIGINT),
+        _cm("cs_bill_hdemo_sk", BIGINT),
+        _cm("cs_bill_addr_sk", BIGINT), _cm("cs_ship_addr_sk", BIGINT),
+        _cm("cs_call_center_sk", BIGINT),
+        _cm("cs_catalog_page_sk", BIGINT),
+        _cm("cs_ship_mode_sk", BIGINT), _cm("cs_promo_sk", BIGINT),
         _cm("cs_warehouse_sk", BIGINT), _cm("cs_quantity", BIGINT),
         _cm("cs_list_price", DOUBLE), _cm("cs_ext_list_price", DOUBLE),
         _cm("cs_sales_price", DOUBLE),
         _cm("cs_ext_sales_price", DOUBLE),
-        _cm("cs_wholesale_cost", DOUBLE), _cm("cs_net_profit", DOUBLE)],
+        _cm("cs_ext_discount_amt", DOUBLE),
+        _cm("cs_wholesale_cost", DOUBLE),
+        _cm("cs_ext_wholesale_cost", DOUBLE),
+        _cm("cs_ext_ship_cost", DOUBLE), _cm("cs_coupon_amt", DOUBLE),
+        _cm("cs_net_paid", DOUBLE), _cm("cs_net_profit", DOUBLE)],
     "catalog_returns": [
         _cm("cr_item_sk", BIGINT), _cm("cr_order_number", BIGINT),
         _cm("cr_returned_date_sk", BIGINT),
         _cm("cr_refunded_cash", DOUBLE),
         _cm("cr_reversed_charge", DOUBLE),
         _cm("cr_store_credit", DOUBLE),
-        _cm("cr_return_quantity", BIGINT)],
+        _cm("cr_return_quantity", BIGINT),
+        _cm("cr_return_amount", DOUBLE), _cm("cr_net_loss", DOUBLE),
+        _cm("cr_returning_customer_sk", BIGINT),
+        _cm("cr_call_center_sk", BIGINT),
+        _cm("cr_catalog_page_sk", BIGINT),
+        _cm("cr_reason_sk", BIGINT)],
+    "web_sales": [
+        _cm("ws_sold_date_sk", BIGINT), _cm("ws_sold_time_sk", BIGINT),
+        _cm("ws_ship_date_sk", BIGINT), _cm("ws_item_sk", BIGINT),
+        _cm("ws_order_number", BIGINT),
+        _cm("ws_bill_customer_sk", BIGINT),
+        _cm("ws_ship_customer_sk", BIGINT),
+        _cm("ws_bill_cdemo_sk", BIGINT),
+        _cm("ws_bill_hdemo_sk", BIGINT),
+        _cm("ws_ship_hdemo_sk", BIGINT),
+        _cm("ws_bill_addr_sk", BIGINT), _cm("ws_ship_addr_sk", BIGINT),
+        _cm("ws_web_page_sk", BIGINT), _cm("ws_web_site_sk", BIGINT),
+        _cm("ws_ship_mode_sk", BIGINT), _cm("ws_warehouse_sk", BIGINT),
+        _cm("ws_promo_sk", BIGINT), _cm("ws_quantity", BIGINT),
+        _cm("ws_wholesale_cost", DOUBLE), _cm("ws_list_price", DOUBLE),
+        _cm("ws_sales_price", DOUBLE),
+        _cm("ws_ext_discount_amt", DOUBLE),
+        _cm("ws_ext_sales_price", DOUBLE),
+        _cm("ws_ext_wholesale_cost", DOUBLE),
+        _cm("ws_ext_list_price", DOUBLE),
+        _cm("ws_ext_ship_cost", DOUBLE), _cm("ws_net_paid", DOUBLE),
+        _cm("ws_net_profit", DOUBLE)],
+    "web_returns": [
+        _cm("wr_returned_date_sk", BIGINT), _cm("wr_item_sk", BIGINT),
+        _cm("wr_order_number", BIGINT),
+        _cm("wr_refunded_customer_sk", BIGINT),
+        _cm("wr_returning_customer_sk", BIGINT),
+        _cm("wr_web_page_sk", BIGINT), _cm("wr_reason_sk", BIGINT),
+        _cm("wr_return_quantity", BIGINT),
+        _cm("wr_return_amt", DOUBLE), _cm("wr_net_loss", DOUBLE),
+        _cm("wr_refunded_cash", DOUBLE)],
+    "web_site": [
+        _cm("web_site_sk", BIGINT), _cm("web_site_id", _V(16)),
+        _cm("web_name", _V(50)), _cm("web_company_name", _V(50))],
+    "web_page": [
+        _cm("wp_web_page_sk", BIGINT), _cm("wp_web_page_id", _V(16)),
+        _cm("wp_char_count", BIGINT)],
+    "inventory": [
+        _cm("inv_date_sk", BIGINT), _cm("inv_item_sk", BIGINT),
+        _cm("inv_warehouse_sk", BIGINT),
+        _cm("inv_quantity_on_hand", BIGINT)],
+    "time_dim": [
+        _cm("t_time_sk", BIGINT), _cm("t_time", BIGINT),
+        _cm("t_hour", BIGINT), _cm("t_minute", BIGINT),
+        _cm("t_second", BIGINT), _cm("t_am_pm", _V(2)),
+        _cm("t_meal_time", _V(20))],
+    "reason": [
+        _cm("r_reason_sk", BIGINT), _cm("r_reason_id", _V(16)),
+        _cm("r_reason_desc", _V(100))],
+    "ship_mode": [
+        _cm("sm_ship_mode_sk", BIGINT), _cm("sm_ship_mode_id", _V(16)),
+        _cm("sm_type", _V(30)), _cm("sm_carrier", _V(20)),
+        _cm("sm_code", _V(10))],
+    "call_center": [
+        _cm("cc_call_center_sk", BIGINT),
+        _cm("cc_call_center_id", _V(16)), _cm("cc_name", _V(50)),
+        _cm("cc_manager", _V(40)), _cm("cc_county", _V(30))],
+    "catalog_page": [
+        _cm("cp_catalog_page_sk", BIGINT),
+        _cm("cp_catalog_page_id", _V(16)),
+        _cm("cp_catalog_number", BIGINT),
+        _cm("cp_catalog_page_number", BIGINT)],
 }

@@ -77,6 +77,33 @@ _CHAIN_JIT_CACHE: Dict[tuple, object] = {}
 _CHAIN_JIT_DENY: set = set()
 
 
+_VOLATILE_FNS = {"now", "current_date", "current_time",
+                 "current_timestamp", "localtime", "localtimestamp",
+                 "random", "rand", "uuid"}
+
+
+def _expr_volatile(e) -> bool:
+    """True when the expression tree contains a volatile call — its
+    value must be re-evaluated per query, so the plan may NOT be served
+    from a cross-query program cache (the trace would freeze the first
+    query's clock/randomness)."""
+    from ..rex import Call as _C
+    if isinstance(e, _C) and e.fn in _VOLATILE_FNS:
+        return True
+    import dataclasses
+    if dataclasses.is_dataclass(e):
+        for f in dataclasses.fields(e):
+            v = getattr(e, f.name)
+            for item in (v if isinstance(v, (tuple, list)) else (v,)):
+                if isinstance(item, (tuple, list)):
+                    if any(_expr_volatile(x) for x in item):
+                        return True
+                elif dataclasses.is_dataclass(item) \
+                        and _expr_volatile(item):
+                    return True
+    return False
+
+
 def _node_fingerprint(nd) -> Optional[tuple]:
     """Serialize every field a jitted evaluation of this node depends
     on (row expressions are frozen dataclasses — repr() is total).
@@ -85,8 +112,12 @@ def _node_fingerprint(nd) -> Optional[tuple]:
     different plans would reuse the wrong program, so any new field on
     these nodes MUST be added here."""
     if isinstance(nd, FilterNode):
+        if _expr_volatile(nd.predicate):
+            return None
         return ("F", repr(nd.predicate))
     if isinstance(nd, ProjectNode):
+        if any(_expr_volatile(e) for e in nd.assignments.values()):
+            return None
         return ("P", tuple((s, repr(e))
                            for s, e in nd.assignments.items()))
     if isinstance(nd, SampleNode):
@@ -111,10 +142,21 @@ def _node_fingerprint(nd) -> Optional[tuple]:
     return None
 
 
+import threading as _jit_threading
+
+_JIT_CACHE_LOCK = _jit_threading.Lock()
+
+
 def _cache_put(cache: Dict[tuple, object], key: tuple, val) -> None:
-    while len(cache) >= 256:
-        cache.pop(next(iter(cache)))
-    cache[key] = val
+    # the coordinator runs one thread per query (server/coordinator.py)
+    # — insert-with-eviction must not race another thread's eviction
+    with _JIT_CACHE_LOCK:
+        while len(cache) >= 256:
+            try:
+                cache.pop(next(iter(cache)))
+            except (KeyError, StopIteration):
+                break
+        cache[key] = val
 
 
 def _keys_inexact(cols, keys) -> bool:
@@ -294,31 +336,9 @@ class Executor:
         phys = post = None
         helper = self._detached()   # closures below are cached
 
-        def run(b: Batch) -> Batch:
+        def partial(b: Batch):
             # selection-vector execution: the filter chain becomes a
             # live mask consumed by the aggregation (no compaction)
-            cols, live = helper._masked_chain_eval(chain, b)
-            src = Batch(cols, jnp.sum(live.astype(jnp.int64)))
-            _p, _post, extra = _lower_aggregates(node.aggregates, src)
-            if extra:
-                c2 = dict(src.columns)
-                c2.update(extra)
-                src = Batch(c2, src.num_rows)
-            if node.group_keys:
-                return group_aggregate(src, list(node.group_keys), _p,
-                                       live=live)
-            return _pad_partial(global_aggregate(src, _p, live=live))
-
-        fkey = (self._stream_fingerprint(chain, node)
-                if self.fragment_jit else None)
-
-        def run_full(b: Batch) -> Batch:
-            """Whole-table single program: partial aggregation + final
-            combine + post-processing (avg = sum/count etc.) fused into
-            one XLA computation — the shape of the hand-fused micro.
-            Aggregates are lowered against the CHAIN OUTPUT columns
-            (projection-created symbols like checksum's arg live there,
-            not on the raw scan batch)."""
             cols, live = helper._masked_chain_eval(chain, b)
             src = Batch(cols, jnp.sum(live.astype(jnp.int64)))
             _p, _post, extra = _lower_aggregates(node.aggregates, src)
@@ -331,6 +351,22 @@ class Executor:
                                       live=live)
             else:
                 out = _pad_partial(global_aggregate(src, _p, live=live))
+            return out, _p, _post
+
+        def run(b: Batch) -> Batch:
+            return partial(b)[0]
+
+        fkey = (self._stream_fingerprint(chain, node)
+                if self.fragment_jit else None)
+
+        def run_full(b: Batch) -> Batch:
+            """Whole-table single program: partial aggregation + final
+            combine + post-processing (avg = sum/count etc.) fused into
+            one XLA computation — the shape of the hand-fused micro.
+            partial() lowers aggregates against the CHAIN OUTPUT
+            columns (projection-created symbols like checksum's arg
+            live there, not on the raw scan batch)."""
+            out, _p, _post = partial(b)
             from ..ops.groupby import COMBINABLE_KINDS
             fin = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
                             a.output) for a in _p]
@@ -1262,7 +1298,7 @@ def read_table_cached(conn, handle, columns, par) -> Optional[Batch]:
     keeping both would double-count the budget). Returns None when the
     mode is off or the table exceeds the cache budget; callers fall
     back to split streaming."""
-    if not getattr(conn, "scan_cache_ok", False) \
+    if not columns or not getattr(conn, "scan_cache_ok", False) \
             or CONFIG.scan_cache_bytes <= 0 or not _whole_table_mode():
         return None
     h = handle
@@ -1275,6 +1311,16 @@ def read_table_cached(conn, handle, columns, par) -> Optional[Batch]:
         if not missing:
             return Batch({c: entry["cols"][c] for c in columns},
                          entry["num_rows"])
+    # cheap pre-check from the handle's row estimate so an over-budget
+    # table (inventory@sf10 is ~4GB of lanes) is never transiently
+    # materialized whole in HBM just to discover it doesn't fit
+    est_rows = None
+    if hasattr(conn, "table_row_count"):
+        est_rows = conn.table_row_count(h)
+    if est_rows:
+        est = int(est_rows) * max(len(columns), 1) * 9  # data8+valid1
+        if 2 * est > CONFIG.scan_cache_bytes:
+            return None
     splits = conn.get_splits(h, par)
     if len(splits) == 1:
         return read_split_cached(conn, splits[0], columns)

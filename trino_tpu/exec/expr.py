@@ -94,8 +94,15 @@ def _const_column(e: Const, cap: int) -> Column:
                       data2=jnp.full((cap,), off, jnp.int64))
     if isinstance(t, DecimalType):
         v = e.value
-        q = int(round(float(v) * (10 ** t.scale))) if not isinstance(
-            v, int) else v * 10 ** t.scale
+        if isinstance(v, int):
+            q = v * 10 ** t.scale
+        elif isinstance(v, str):
+            # exact: a float round-trip would corrupt literals beyond
+            # 2^53 (q34-style wide-decimal comparisons)
+            from decimal import Decimal as _D
+            q = int((_D(v) * (10 ** t.scale)).to_integral_value())
+        else:
+            q = int(round(float(v) * (10 ** t.scale)))
         if not t.is_short:
             lo = q & ((1 << 64) - 1)
             lo = lo - (1 << 64) if lo >= (1 << 63) else lo
@@ -338,6 +345,9 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
                           src.valid)
         if isinstance(t, DecimalType):
             shift = t.scale - s.scale
+            if shift == 0:
+                # precision-only change: keep both Int128 lanes intact
+                return dc_replace(src, type=t)
             if shift >= 0:
                 nd = d * (10 ** shift)
             else:
@@ -593,11 +603,38 @@ def _cmp(op: str):
         da, db = _lane(a), _lane(b)
         if isinstance(a.type, DecimalType) and (a.data2 is not None
                                                 or b.data2 is not None):
-            raise EvalError("DECIMAL(p>18) comparisons not supported yet")
-        data = _cmp_lanes(op, da, db)
+            data = _cmp_int128(op, a, b)
+        else:
+            data = _cmp_lanes(op, da, db)
         return Column(BOOLEAN, data, valid)
 
     return h
+
+
+def _cmp_int128(op, a: Column, b: Column):
+    """Two's-complement 128-bit comparison over (hi, lo) lanes: signed
+    on the high word, unsigned on the low (the sign-bit-flip trick
+    turns int64 order into uint64 order — the TPU path has no native
+    u64 compare). A side without a hi lane sign-extends its low word.
+    Reference: Int128Math/Decimal comparisons in spi/type/Decimals."""
+    lo_a = jnp.asarray(a.data).astype(jnp.int64)
+    lo_b = jnp.asarray(b.data).astype(jnp.int64)
+    hi_a = (jnp.asarray(a.data2).astype(jnp.int64)
+            if a.data2 is not None else lo_a >> 63)
+    hi_b = (jnp.asarray(b.data2).astype(jnp.int64)
+            if b.data2 is not None else lo_b >> 63)
+    sbit = jnp.int64(-(2 ** 63))
+    ua, ub = lo_a ^ sbit, lo_b ^ sbit
+    if op in ("=", "<>"):
+        eq = (hi_a == hi_b) & (lo_a == lo_b)
+        return eq if op == "=" else ~eq
+    lt = (hi_a < hi_b) | ((hi_a == hi_b) & (ua < ub))
+    if op == "<":
+        return lt
+    if op == ">=":
+        return ~lt
+    gt = (hi_a > hi_b) | ((hi_a == hi_b) & (ua > ub))
+    return gt if op == ">" else ~gt
 
 
 def _cmp_lanes(op, da, db):
@@ -2282,6 +2319,8 @@ def _to_utf8(e, batch):
     hmac_*/md5/length over the result see the actual byte sequence,
     including for non-latin-1 text)."""
     a = eval_expr(e.args[0], batch)
+    if a.dictionary is None:      # all-NULL UNKNOWN constant
+        return dc_replace(a, type=e.type)
     return _dict_transform(
         a, lambda s: s.encode("utf-8").decode("latin-1"), e.type)
 
@@ -2291,6 +2330,8 @@ def _from_utf8(e, batch):
     sequences replaced with U+FFFD (reference
     VarbinaryFunctions.fromUtf8 default behavior)."""
     a = eval_expr(e.args[0], batch)
+    if a.dictionary is None:      # all-NULL UNKNOWN constant
+        return dc_replace(a, type=e.type)
     return _dict_transform(
         a, lambda s: s.encode("latin-1", errors="replace")
                       .decode("utf-8", errors="replace"), e.type)

@@ -21,7 +21,6 @@ code.
 
 from __future__ import annotations
 
-import heapq
 from typing import List, Optional, Tuple
 
 import jax

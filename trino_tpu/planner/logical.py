@@ -662,6 +662,8 @@ class LogicalPlanner:
         post = _ExprContext(self, ctx.scope, agg_node,
                             agg_map=agg_map, key_map=key_map,
                             group_symbols=set(agg_node.group_keys))
+        if id_sym is not None:
+            post.grouping_info = (id_sym, set_syms)
         return post, key_syms
 
     def _rewrite_distinct_aggregation(self, node: AggregationNode):
@@ -1732,6 +1734,34 @@ def _plan_function(self: LogicalPlanner, e: A.FunctionCall,
     if name in _HIGHER_ORDER and any(
             isinstance(a, A.LambdaExpression) for a in e.args):
         return _plan_higher_order(self, e, ctx)
+    if name == "grouping":
+        # grouping(c1, .., cn): bitmask with bit (n-1-i) set when ci is
+        # NOT grouped in this row's grouping set (reference:
+        # sql/analyzer + GroupingOperationRewriter — decoded here from
+        # the GroupIdNode set index; constant 0 for plain GROUP BY)
+        if ctx.group_symbols is None and not ctx.agg_map:
+            raise PlanningError("grouping() requires GROUP BY")
+        info = getattr(ctx, "grouping_info", None)
+        arg_refs = []
+        for a in e.args:
+            r = self._rewrite_expr(a, ctx)
+            if not isinstance(r, InputRef):
+                raise PlanningError(
+                    "grouping() arguments must be grouping expressions")
+            arg_refs.append(r.name)
+        if info is None:
+            return Const(0, BIGINT)
+        id_sym, set_syms = info
+        from ..rex import CaseExpr
+        whens = []
+        for k, sset in enumerate(set_syms):
+            mask = 0
+            for s in arg_refs:
+                mask = (mask << 1) | (0 if s in sset else 1)
+            whens.append((Call("=", (InputRef(id_sym, BIGINT),
+                                     Const(k, BIGINT)), BOOLEAN),
+                          Const(mask, BIGINT)))
+        return CaseExpr(tuple(whens), Const(None, BIGINT), BIGINT)
     if is_aggregate(name):
         if ctx.group_symbols is None and not ctx.agg_map:
             raise PlanningError(
