@@ -35,7 +35,7 @@ _ORACLE_TABLES = {
                     "ss_quantity", "ss_wholesale_cost", "ss_list_price",
                     "ss_sales_price", "ss_ext_sales_price",
                     "ss_ext_wholesale_cost", "ss_ext_list_price",
-                    "ss_ext_tax",
+                    "ss_ext_tax", "ss_ext_discount_amt", "ss_net_paid",
                     "ss_coupon_amt", "ss_net_profit"],
     "store_returns": ["sr_item_sk", "sr_ticket_number",
                       "sr_returned_date_sk", "sr_customer_sk",
@@ -61,13 +61,14 @@ _ORACLE_TABLES = {
                         "cr_call_center_sk"],
     "store": ["s_store_sk", "s_store_id", "s_store_name", "s_zip",
               "s_state", "s_city", "s_number_employees", "s_county",
-              "s_company_name"],
+              "s_company_name", "s_company_id", "s_street_number",
+              "s_street_name", "s_street_type", "s_suite_number"],
     "customer": ["c_customer_sk", "c_customer_id",
                  "c_first_name", "c_last_name", "c_current_cdemo_sk",
                  "c_current_hdemo_sk", "c_current_addr_sk",
                  "c_first_sales_date_sk", "c_first_shipto_date_sk",
                  "c_birth_year", "c_birth_month", "c_salutation",
-                 "c_preferred_cust_flag"],
+                 "c_preferred_cust_flag", "c_birth_country"],
     "customer_demographics": ["cd_demo_sk", "cd_gender",
                               "cd_marital_status",
                               "cd_education_status", "cd_dep_count"],
@@ -91,7 +92,7 @@ _ORACLE_TABLES = {
                   "ws_ext_sales_price", "ws_ext_discount_amt",
                   "ws_ext_ship_cost", "ws_net_paid",
                   "ws_sales_price", "ws_ship_customer_sk",
-                  "ws_net_profit"],
+                  "ws_ext_list_price", "ws_net_profit"],
     "warehouse": ["w_warehouse_sk", "w_warehouse_name"],
     "ship_mode": ["sm_ship_mode_sk", "sm_type"],
     "web_site": ["web_site_sk", "web_name", "web_company_name"],
@@ -286,9 +287,49 @@ WHERE d1.d_year = 2001
   AND s_state IN ('TN', 'OH', 'TX', 'GA', 'IL')
 """
 
+_Q70_BODY = """
+FROM store_sales, date_dim d1, store
+WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+  AND d1.d_date_sk = ss_sold_date_sk
+  AND s_store_sk = ss_store_sk
+  AND s_state IN
+      (SELECT s_state
+       FROM (SELECT s_state s_state,
+                    rank() OVER (PARTITION BY s_state
+                                 ORDER BY sum(ss_net_profit)
+                                     DESC) ranking
+             FROM store_sales, store, date_dim
+             WHERE d_month_seq BETWEEN 1200 AND 1211
+               AND d_date_sk = ss_sold_date_sk
+               AND s_store_sk = ss_store_sk
+             GROUP BY s_state) tmp1
+       WHERE ranking <= 5)
+"""
+
 _ORACLE_OVERRIDE = {
     48: _Q48_ORACLE,
     13: _Q13_ORACLE,
+    # sqlite has no ROLLUP: q70 expands to its 3 grouping levels
+    70: f"""
+SELECT total_sum, s_state, s_county, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN county_grouping = 0
+                             THEN s_state END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM (SELECT sum(ss_net_profit) total_sum, s_state, s_county,
+             0 lochierarchy, 0 county_grouping
+      {_Q70_BODY} GROUP BY s_state, s_county
+      UNION ALL
+      SELECT sum(ss_net_profit), s_state, NULL, 1, 1
+      {_Q70_BODY} GROUP BY s_state
+      UNION ALL
+      SELECT sum(ss_net_profit), NULL, NULL, 2, 1
+      {_Q70_BODY}) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN s_state END,
+         rank_within_parent
+LIMIT 100
+""",
     # sqlite rejects parenthesized compound-select members: restate
     # q8/q87 with bare INTERSECT/EXCEPT (left-assoc, same semantics)
     8: """

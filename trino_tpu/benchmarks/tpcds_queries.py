@@ -1445,4 +1445,452 @@ GROUP BY cc_call_center_id, cc_name, cc_manager,
          cd_marital_status, cd_education_status
 ORDER BY sum(cr_net_loss) DESC
 """,
+    2: """
+WITH wscs AS (
+  SELECT sold_date_sk, sales_price
+  FROM (SELECT ws_sold_date_sk sold_date_sk,
+               ws_ext_sales_price sales_price
+        FROM web_sales
+        UNION ALL
+        SELECT cs_sold_date_sk, cs_ext_sales_price
+        FROM catalog_sales) t),
+wswscs AS (
+  SELECT d_week_seq,
+         sum(CASE WHEN d_day_name = 'Sunday'
+                  THEN sales_price ELSE NULL END) sun_sales,
+         sum(CASE WHEN d_day_name = 'Monday'
+                  THEN sales_price ELSE NULL END) mon_sales,
+         sum(CASE WHEN d_day_name = 'Tuesday'
+                  THEN sales_price ELSE NULL END) tue_sales,
+         sum(CASE WHEN d_day_name = 'Wednesday'
+                  THEN sales_price ELSE NULL END) wed_sales,
+         sum(CASE WHEN d_day_name = 'Thursday'
+                  THEN sales_price ELSE NULL END) thu_sales,
+         sum(CASE WHEN d_day_name = 'Friday'
+                  THEN sales_price ELSE NULL END) fri_sales,
+         sum(CASE WHEN d_day_name = 'Saturday'
+                  THEN sales_price ELSE NULL END) sat_sales
+  FROM wscs, date_dim
+  WHERE d_date_sk = sold_date_sk
+  GROUP BY d_week_seq)
+SELECT d_week_seq1, round(sun_sales1 / sun_sales2, 2) r1,
+       round(mon_sales1 / mon_sales2, 2) r2,
+       round(tue_sales1 / tue_sales2, 2) r3,
+       round(wed_sales1 / wed_sales2, 2) r4,
+       round(thu_sales1 / thu_sales2, 2) r5,
+       round(fri_sales1 / fri_sales2, 2) r6,
+       round(sat_sales1 / sat_sales2, 2) r7
+FROM (SELECT wswscs.d_week_seq d_week_seq1,
+             sun_sales sun_sales1, mon_sales mon_sales1,
+             tue_sales tue_sales1, wed_sales wed_sales1,
+             thu_sales thu_sales1, fri_sales fri_sales1,
+             sat_sales sat_sales1
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq
+        AND d_year = 2001) y,
+     (SELECT wswscs.d_week_seq d_week_seq2,
+             sun_sales sun_sales2, mon_sales mon_sales2,
+             tue_sales tue_sales2, wed_sales wed_sales2,
+             thu_sales thu_sales2, fri_sales fri_sales2,
+             sat_sales sat_sales2
+      FROM wswscs, date_dim
+      WHERE date_dim.d_week_seq = wswscs.d_week_seq
+        AND d_year = 2002) z
+WHERE d_week_seq1 = d_week_seq2 - 53
+ORDER BY d_week_seq1
+""",
+    9: """
+SELECT CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) > 20000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20)
+            ELSE (SELECT avg(ss_net_profit) FROM store_sales
+                  WHERE ss_quantity BETWEEN 1 AND 20) END bucket1,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) > 15000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40)
+            ELSE (SELECT avg(ss_net_profit) FROM store_sales
+                  WHERE ss_quantity BETWEEN 21 AND 40) END bucket2,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) > 10000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60)
+            ELSE (SELECT avg(ss_net_profit) FROM store_sales
+                  WHERE ss_quantity BETWEEN 41 AND 60) END bucket3,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80) > 5000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80)
+            ELSE (SELECT avg(ss_net_profit) FROM store_sales
+                  WHERE ss_quantity BETWEEN 61 AND 80) END bucket4,
+       CASE WHEN (SELECT count(*) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100) > 1000
+            THEN (SELECT avg(ss_ext_discount_amt) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100)
+            ELSE (SELECT avg(ss_net_profit) FROM store_sales
+                  WHERE ss_quantity BETWEEN 81 AND 100) END bucket5
+FROM reason
+WHERE r_reason_sk = 1
+""",
+    11: """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country,
+         d_year dyear,
+         sum(ss_ext_list_price - ss_ext_discount_amt) year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_country, d_year,
+         sum(ws_ext_list_price - ws_ext_discount_amt) year_total,
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's'
+  AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's'
+  AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001
+  AND t_s_secyear.dyear = 2001 + 1
+  AND t_w_firstyear.dyear = 2001
+  AND t_w_secyear.dyear = 2001 + 1
+  AND t_s_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE 0.0 END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE 0.0 END
+ORDER BY t_s_secyear.customer_id,
+         t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+LIMIT 100
+""",
+    47: """
+WITH v1 AS (
+  SELECT i_category, i_brand, s_store_name, s_company_name,
+         d_year, d_moy, sum_sales,
+         avg(sum_sales) OVER (PARTITION BY i_category, i_brand,
+                                  s_store_name, s_company_name,
+                                  d_year) avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand,
+                          s_store_name, s_company_name
+                      ORDER BY d_year, d_moy) rn
+  FROM (SELECT i_category, i_brand, s_store_name, s_company_name,
+               d_year, d_moy, sum(ss_sales_price) sum_sales
+        FROM item, store_sales, date_dim, store
+        WHERE ss_item_sk = i_item_sk
+          AND ss_sold_date_sk = d_date_sk
+          AND ss_store_sk = s_store_sk
+          AND (d_year = 1999
+               OR (d_year = 1998 AND d_moy = 12)
+               OR (d_year = 2000 AND d_moy = 1))
+        GROUP BY i_category, i_brand, s_store_name,
+                 s_company_name, d_year, d_moy) inner_v1),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.s_store_name,
+         v1.s_company_name, v1.d_year, v1.d_moy,
+         v1.avg_monthly_sales, v1.sum_sales,
+         v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.s_store_name = v1_lag.s_store_name
+    AND v1.s_store_name = v1_lead.s_store_name
+    AND v1.s_company_name = v1_lag.s_company_name
+    AND v1.s_company_name = v1_lead.s_company_name
+    AND v1.rn = v1_lag.rn + 1
+    AND v1.rn = v1_lead.rn - 1)
+SELECT *
+FROM v2
+WHERE d_year = 1999
+  AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales)
+                / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, nsum
+LIMIT 100
+""",
+    50: """
+SELECT s_store_name, s_company_id, s_street_number, s_street_name,
+       s_street_type, s_suite_number, s_city, s_county, s_state,
+       s_zip,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk <= 30
+                THEN 1 ELSE 0 END) AS days30,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 30
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 60
+                THEN 1 ELSE 0 END) AS days31_60,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 60
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 90
+                THEN 1 ELSE 0 END) AS days61_90,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 90
+                 AND sr_returned_date_sk - ss_sold_date_sk <= 120
+                THEN 1 ELSE 0 END) AS days91_120,
+       sum(CASE WHEN sr_returned_date_sk - ss_sold_date_sk > 120
+                THEN 1 ELSE 0 END) AS days_over_120
+FROM store_sales, store_returns, store, date_dim d1, date_dim d2
+WHERE d2.d_year = 2001 AND d2.d_moy = 8
+  AND ss_ticket_number = sr_ticket_number
+  AND ss_item_sk = sr_item_sk
+  AND ss_sold_date_sk = d1.d_date_sk
+  AND sr_returned_date_sk = d2.d_date_sk
+  AND ss_customer_sk = sr_customer_sk
+  AND ss_store_sk = s_store_sk
+GROUP BY s_store_name, s_company_id, s_street_number,
+         s_street_name, s_street_type, s_suite_number, s_city,
+         s_county, s_state, s_zip
+ORDER BY s_store_name, s_company_id, s_street_number,
+         s_street_name, s_street_type, s_suite_number, s_city,
+         s_county, s_state, s_zip
+LIMIT 100
+""",
+    51: """
+WITH web_v1 AS (
+  SELECT item_sk, d_date,
+         sum(daily) OVER (PARTITION BY item_sk ORDER BY d_date
+                          ROWS BETWEEN UNBOUNDED PRECEDING
+                               AND CURRENT ROW) cume_sales
+  FROM (SELECT ws_item_sk item_sk, d_date,
+               sum(ws_sales_price) daily
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+          AND ws_item_sk IS NOT NULL
+        GROUP BY ws_item_sk, d_date) t),
+store_v1 AS (
+  SELECT item_sk, d_date,
+         sum(daily) OVER (PARTITION BY item_sk ORDER BY d_date
+                          ROWS BETWEEN UNBOUNDED PRECEDING
+                               AND CURRENT ROW) cume_sales
+  FROM (SELECT ss_item_sk item_sk, d_date,
+               sum(ss_sales_price) daily
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk
+          AND d_month_seq BETWEEN 1200 AND 1211
+          AND ss_item_sk IS NOT NULL
+        GROUP BY ss_item_sk, d_date) t)
+SELECT *
+FROM (SELECT item_sk, d_date, web_sales, store_sales,
+             max(web_sales) OVER (PARTITION BY item_sk
+                                  ORDER BY d_date
+                                  ROWS BETWEEN UNBOUNDED PRECEDING
+                                       AND CURRENT ROW)
+                 web_cumulative,
+             max(store_sales) OVER (PARTITION BY item_sk
+                                    ORDER BY d_date
+                                    ROWS BETWEEN UNBOUNDED PRECEDING
+                                         AND CURRENT ROW)
+                 store_cumulative
+      FROM (SELECT CASE WHEN web.item_sk IS NOT NULL
+                        THEN web.item_sk ELSE store.item_sk END
+                       item_sk,
+                   CASE WHEN web.d_date IS NOT NULL
+                        THEN web.d_date ELSE store.d_date END d_date,
+                   web.cume_sales web_sales,
+                   store.cume_sales store_sales
+            FROM web_v1 web
+            FULL OUTER JOIN store_v1 store
+                ON (web.item_sk = store.item_sk
+                    AND web.d_date = store.d_date)) x) y
+WHERE web_cumulative > store_cumulative
+ORDER BY item_sk, d_date
+LIMIT 100
+""",
+    57: """
+WITH v1 AS (
+  SELECT i_category, i_brand, cc_name, d_year, d_moy, sum_sales,
+         avg(sum_sales) OVER (PARTITION BY i_category, i_brand,
+                                  cc_name, d_year)
+             avg_monthly_sales,
+         rank() OVER (PARTITION BY i_category, i_brand, cc_name
+                      ORDER BY d_year, d_moy) rn
+  FROM (SELECT i_category, i_brand, cc_name, d_year, d_moy,
+               sum(cs_sales_price) sum_sales
+        FROM item, catalog_sales, date_dim, call_center
+        WHERE cs_item_sk = i_item_sk
+          AND cs_sold_date_sk = d_date_sk
+          AND cc_call_center_sk = cs_call_center_sk
+          AND (d_year = 1999
+               OR (d_year = 1998 AND d_moy = 12)
+               OR (d_year = 2000 AND d_moy = 1))
+        GROUP BY i_category, i_brand, cc_name, d_year,
+                 d_moy) inner_v1),
+v2 AS (
+  SELECT v1.i_category, v1.i_brand, v1.cc_name, v1.d_year,
+         v1.d_moy, v1.avg_monthly_sales, v1.sum_sales,
+         v1_lag.sum_sales psum, v1_lead.sum_sales nsum
+  FROM v1, v1 v1_lag, v1 v1_lead
+  WHERE v1.i_category = v1_lag.i_category
+    AND v1.i_category = v1_lead.i_category
+    AND v1.i_brand = v1_lag.i_brand
+    AND v1.i_brand = v1_lead.i_brand
+    AND v1.cc_name = v1_lag.cc_name
+    AND v1.cc_name = v1_lead.cc_name
+    AND v1.rn = v1_lag.rn + 1
+    AND v1.rn = v1_lead.rn - 1)
+SELECT *
+FROM v2
+WHERE d_year = 1999
+  AND avg_monthly_sales > 0
+  AND CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales)
+                / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY sum_sales - avg_monthly_sales, nsum
+LIMIT 100
+""",
+    63: """
+SELECT *
+FROM (SELECT i_manager_id, sum_sales,
+             avg(sum_sales) OVER (PARTITION BY i_manager_id)
+                 avg_monthly_sales
+      FROM (SELECT i_manager_id, sum(ss_sales_price) sum_sales
+            FROM item, store_sales, date_dim, store
+            WHERE ss_item_sk = i_item_sk
+              AND ss_sold_date_sk = d_date_sk
+              AND ss_store_sk = s_store_sk
+              AND d_month_seq IN (1200, 1201, 1202, 1203, 1204,
+                                  1205, 1206, 1207, 1208, 1209,
+                                  1210, 1211)
+              AND ((i_category IN ('Books', 'Children',
+                                   'Electronics')
+                    AND i_class IN ('class#1', 'class#2',
+                                    'class#3'))
+                   OR (i_category IN ('Women', 'Music', 'Men')
+                       AND i_class IN ('class#4', 'class#5',
+                                       'class#6')))
+            GROUP BY i_manager_id, d_moy) t1) tmp1
+WHERE CASE WHEN avg_monthly_sales > 0
+           THEN abs(sum_sales - avg_monthly_sales)
+                / avg_monthly_sales
+           ELSE NULL END > 0.1
+ORDER BY i_manager_id, avg_monthly_sales, sum_sales
+LIMIT 100
+""",
+    70: """
+SELECT total_sum, s_state, s_county, lochierarchy,
+       rank() OVER (PARTITION BY lochierarchy,
+                        CASE WHEN county_grouping = 0
+                             THEN s_state END
+                    ORDER BY total_sum DESC) rank_within_parent
+FROM (SELECT sum(ss_net_profit) total_sum, s_state, s_county,
+             grouping(s_state) + grouping(s_county) lochierarchy,
+             grouping(s_county) county_grouping
+      FROM store_sales, date_dim d1, store
+      WHERE d1.d_month_seq BETWEEN 1200 AND 1211
+        AND d1.d_date_sk = ss_sold_date_sk
+        AND s_store_sk = ss_store_sk
+        AND s_state IN
+            (SELECT s_state
+             FROM (SELECT s_state s_state,
+                          rank() OVER (PARTITION BY s_state
+                                       ORDER BY sum(ss_net_profit)
+                                           DESC) ranking
+                   FROM store_sales, store, date_dim
+                   WHERE d_month_seq BETWEEN 1200 AND 1211
+                     AND d_date_sk = ss_sold_date_sk
+                     AND s_store_sk = ss_store_sk
+                   GROUP BY s_state) tmp1
+             WHERE ranking <= 5)
+      GROUP BY ROLLUP (s_state, s_county)) t
+ORDER BY lochierarchy DESC,
+         CASE WHEN lochierarchy = 0 THEN s_state END,
+         rank_within_parent
+LIMIT 100
+""",
+    74: """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name, d_year AS year_,
+         sum(ss_net_paid) year_total, 's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk
+    AND ss_sold_date_sk = d_date_sk
+    AND d_year IN (2001, 2001 + 1)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name, d_year,
+         sum(ws_net_paid), 'w'
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+    AND d_year IN (2001, 2001 + 1)
+  GROUP BY c_customer_id, c_first_name, c_last_name, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.sale_type = 's'
+  AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's'
+  AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.year_ = 2001
+  AND t_s_secyear.year_ = 2001 + 1
+  AND t_w_firstyear.year_ = 2001
+  AND t_w_secyear.year_ = 2001 + 1
+  AND t_s_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_w_firstyear.year_total > 0
+           THEN t_w_secyear.year_total / t_w_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE NULL END
+ORDER BY 1, 1, 1
+LIMIT 100
+""",
+    97: """
+WITH ssci AS (
+  SELECT ss_customer_sk customer_sk, ss_item_sk item_sk
+  FROM store_sales, date_dim
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY ss_customer_sk, ss_item_sk),
+csci AS (
+  SELECT cs_bill_customer_sk customer_sk, cs_item_sk item_sk
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_month_seq BETWEEN 1200 AND 1211
+  GROUP BY cs_bill_customer_sk, cs_item_sk)
+SELECT sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NULL
+                THEN 1 ELSE 0 END) store_only,
+       sum(CASE WHEN ssci.customer_sk IS NULL
+                 AND csci.customer_sk IS NOT NULL
+                THEN 1 ELSE 0 END) catalog_only,
+       sum(CASE WHEN ssci.customer_sk IS NOT NULL
+                 AND csci.customer_sk IS NOT NULL
+                THEN 1 ELSE 0 END) store_and_catalog
+FROM ssci
+FULL OUTER JOIN csci ON (ssci.customer_sk = csci.customer_sk
+                         AND ssci.item_sk = csci.item_sk)
+LIMIT 100
+""",
 }

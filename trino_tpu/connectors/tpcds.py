@@ -583,6 +583,25 @@ class TpcdsConnector(Connector):
             VarcharType(30))
         cols["s_company_name"] = _strings(
             ["Unknown"], np.zeros(n, np.int32), VarcharType(50))
+        cols["s_company_id"] = Column(BIGINT, np.ones(n, np.int64),
+                                      None)
+        cols["s_street_number"] = _strings(
+            [str(v) for v in range(1, 1001)],
+            (_u64(S + 8, idx) % np.uint64(1000)).astype(np.int32),
+            VarcharType(10))
+        sn = (_u64(S + 9, idx)
+              % np.uint64(len(_STREET_NAMES))).astype(np.int32)
+        cols["s_street_name"] = _strings(_STREET_NAMES, sn,
+                                         VarcharType(60))
+        cols["s_street_type"] = _strings(
+            _STREET_TYPES,
+            (_u64(S + 10, idx)
+             % np.uint64(len(_STREET_TYPES))).astype(np.int32),
+            VarcharType(15))
+        cols["s_suite_number"] = _strings(
+            [f"Suite {v}" for v in range(0, 100, 10)],
+            (_u64(S + 11, idx) % np.uint64(10)).astype(np.int32),
+            VarcharType(10))
         return self._finish(cols, n, columns)
 
     def _promotion(self, idx, sf, columns) -> Batch:
@@ -1221,7 +1240,10 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("s_store_name", _V(50)), _cm("s_zip", _V(10)),
         _cm("s_state", _V(2)), _cm("s_city", _V(60)),
         _cm("s_number_employees", BIGINT),
-        _cm("s_county", _V(30)), _cm("s_company_name", _V(50))],
+        _cm("s_county", _V(30)), _cm("s_company_name", _V(50)),
+        _cm("s_company_id", BIGINT), _cm("s_street_number", _V(10)),
+        _cm("s_street_name", _V(60)), _cm("s_street_type", _V(15)),
+        _cm("s_suite_number", _V(10))],
     "promotion": [
         _cm("p_promo_sk", BIGINT), _cm("p_promo_id", _V(16)),
         _cm("p_channel_dmail", _V(1)), _cm("p_channel_email", _V(1)),

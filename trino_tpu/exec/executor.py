@@ -87,21 +87,9 @@ def _expr_volatile(e) -> bool:
     value must be re-evaluated per query, so the plan may NOT be served
     from a cross-query program cache (the trace would freeze the first
     query's clock/randomness)."""
-    from ..rex import Call as _C
-    if isinstance(e, _C) and e.fn in _VOLATILE_FNS:
-        return True
-    import dataclasses
-    if dataclasses.is_dataclass(e):
-        for f in dataclasses.fields(e):
-            v = getattr(e, f.name)
-            for item in (v if isinstance(v, (tuple, list)) else (v,)):
-                if isinstance(item, (tuple, list)):
-                    if any(_expr_volatile(x) for x in item):
-                        return True
-                elif dataclasses.is_dataclass(item) \
-                        and _expr_volatile(item):
-                    return True
-    return False
+    from ..rex import Call as _C, walk as _walk
+    return any(isinstance(x, _C) and x.fn in _VOLATILE_FNS
+               for x in _walk(e))
 
 
 def _node_fingerprint(nd) -> Optional[tuple]:
@@ -1313,12 +1301,14 @@ def read_table_cached(conn, handle, columns, par) -> Optional[Batch]:
                          entry["num_rows"])
     # cheap pre-check from the handle's row estimate so an over-budget
     # table (inventory@sf10 is ~4GB of lanes) is never transiently
-    # materialized whole in HBM just to discover it doesn't fit
+    # materialized whole in HBM just to discover it doesn't fit. Sized
+    # on the MISSING columns only — an almost-fully-cached wide table
+    # must stay admissible for its last few columns.
     est_rows = None
     if hasattr(conn, "table_row_count"):
         est_rows = conn.table_row_count(h)
     if est_rows:
-        est = int(est_rows) * max(len(columns), 1) * 9  # data8+valid1
+        est = int(est_rows) * max(len(missing), 1) * 9  # data8+valid1
         if 2 * est > CONFIG.scan_cache_bytes:
             return None
     splits = conn.get_splits(h, par)

@@ -348,6 +348,12 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
             if shift == 0:
                 # precision-only change: keep both Int128 lanes intact
                 return dc_replace(src, type=t)
+            if src.data2 is not None:
+                # rescaling a live Int128 value needs 128-bit
+                # multiply/divide; silently dropping the hi lane would
+                # return wrong rows — fail loudly instead
+                raise EvalError(
+                    "DECIMAL(p>18) rescale not supported yet")
             if shift >= 0:
                 nd = d * (10 ** shift)
             else:

@@ -309,7 +309,8 @@ class LogicalPlanner:
 
         # window functions
         win_calls = [e for item in select_items
-                     for e in A.walk_expressions(item.expr)
+                     for e in A.walk_expressions(
+                         item.expr, cross_subqueries=False)
                      if isinstance(e, A.FunctionCall) and e.window]
         if win_calls:
             post_ctx = self._plan_windows(post_ctx, win_calls)
@@ -426,12 +427,13 @@ class LogicalPlanner:
         for si in spec.order_by:
             sources.append(si.expr)
         for src in sources:
-            for e in A.walk_expressions(src):
+            for e in A.walk_expressions(src, cross_subqueries=False):
                 if isinstance(e, A.FunctionCall) and not e.window \
                         and is_aggregate(e.name) and e not in seen:
                     # nested aggregates are illegal
                     for a in e.args:
-                        for sub in A.walk_expressions(a):
+                        for sub in A.walk_expressions(
+                                a, cross_subqueries=False):
                             if isinstance(sub, A.FunctionCall) \
                                     and is_aggregate(sub.name):
                                 raise PlanningError(
@@ -798,6 +800,10 @@ class LogicalPlanner:
                            key_map=ctx.key_map,
                            group_symbols=ctx.group_symbols)
         out.win_map = win_map
+        if hasattr(ctx, "grouping_info"):
+            # grouping() must keep decoding the set index after window
+            # planning replaces the context (silently-0 otherwise)
+            out.grouping_info = ctx.grouping_info
         return out
 
     # ---- relations -------------------------------------------------------

@@ -609,8 +609,21 @@ def count_parameters(node) -> int:
                if isinstance(e, Literal) and e.type_name == "parameter")
 
 
-def walk_expressions(node):
-    """Yield every Expression reachable from an AST node (pre-order)."""
+def walk_expressions(node, cross_subqueries: bool = True):
+    """Yield every Expression reachable from an AST node (pre-order).
+
+    ``cross_subqueries=False`` stops at subquery boundaries
+    (QueryStatement/Relation values): an aggregate or window call
+    inside a ScalarSubquery belongs to THAT query's planning, not the
+    enclosing one — descending made `CASE WHEN (SELECT count(*) ...)`
+    hoist the inner aggregate into the outer AggregationNode."""
+    def _push(stack, v):
+        if isinstance(v, (Query, QueryBody, QueryStatement, Relation)) \
+                and not cross_subqueries:
+            return
+        if isinstance(v, Node):
+            stack.append(v)
+
     stack = [node]
     while stack:
         n = stack.pop()
@@ -620,11 +633,12 @@ def walk_expressions(node):
             for f in n.__dataclass_fields__:
                 v = getattr(n, f)
                 if isinstance(v, Node):
-                    stack.append(v)
+                    _push(stack, v)
                 elif isinstance(v, tuple):
                     for item in v:
                         if isinstance(item, Node):
-                            stack.append(item)
+                            _push(stack, item)
                         elif isinstance(item, tuple):
-                            stack.extend(x for x in item
-                                         if isinstance(x, Node))
+                            for x in item:
+                                if isinstance(x, Node):
+                                    _push(stack, x)
