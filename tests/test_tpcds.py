@@ -27,7 +27,8 @@ _ORACLE_TABLES = {
              "i_item_desc", "i_color", "i_current_price",
              "i_wholesale_cost", "i_brand_id", "i_brand",
              "i_manufact_id", "i_manufact", "i_category_id",
-             "i_category", "i_class_id", "i_class", "i_manager_id"],
+             "i_category", "i_class_id", "i_class", "i_manager_id",
+             "i_units", "i_size"],
     "store_sales": ["ss_sold_date_sk", "ss_sold_time_sk",
                     "ss_item_sk", "ss_customer_sk",
                     "ss_cdemo_sk", "ss_hdemo_sk", "ss_addr_sk",
@@ -45,20 +46,24 @@ _ORACLE_TABLES = {
     "catalog_sales": ["cs_item_sk", "cs_order_number",
                       "cs_ext_list_price", "cs_sold_date_sk",
                       "cs_ship_date_sk", "cs_bill_customer_sk",
+                      "cs_ship_customer_sk",
                       "cs_bill_cdemo_sk", "cs_promo_sk",
                       "cs_warehouse_sk", "cs_ship_mode_sk",
                       "cs_call_center_sk", "cs_quantity",
                       "cs_list_price", "cs_coupon_amt",
                       "cs_ext_discount_amt", "cs_ext_sales_price",
                       "cs_ship_addr_sk", "cs_ext_ship_cost",
-                      "cs_bill_addr_sk",
+                      "cs_bill_addr_sk", "cs_ext_wholesale_cost",
+                      "cs_net_paid",
                       "cs_sales_price", "cs_net_profit"],
     "catalog_returns": ["cr_item_sk", "cr_order_number",
                         "cr_refunded_cash", "cr_reversed_charge",
                         "cr_store_credit", "cr_net_loss",
                         "cr_returned_date_sk",
                         "cr_returning_customer_sk",
-                        "cr_call_center_sk"],
+                        "cr_call_center_sk", "cr_return_quantity",
+                        "cr_return_amount", "cr_return_amt_inc_tax",
+                        "cr_returning_addr_sk"],
     "store": ["s_store_sk", "s_store_id", "s_store_name", "s_zip",
               "s_state", "s_city", "s_number_employees", "s_county",
               "s_company_name", "s_company_id", "s_street_number",
@@ -67,21 +72,28 @@ _ORACLE_TABLES = {
                  "c_first_name", "c_last_name", "c_current_cdemo_sk",
                  "c_current_hdemo_sk", "c_current_addr_sk",
                  "c_first_sales_date_sk", "c_first_shipto_date_sk",
-                 "c_birth_year", "c_birth_month", "c_salutation",
+                 "c_birth_year", "c_birth_month", "c_birth_day",
+                 "c_salutation",
                  "c_preferred_cust_flag", "c_birth_country"],
     "customer_demographics": ["cd_demo_sk", "cd_gender",
                               "cd_marital_status",
-                              "cd_education_status", "cd_dep_count"],
+                              "cd_education_status", "cd_dep_count",
+                              "cd_purchase_estimate",
+                              "cd_credit_rating",
+                              "cd_dep_employed_count",
+                              "cd_dep_college_count"],
     "household_demographics": ["hd_demo_sk", "hd_income_band_sk",
                                "hd_buy_potential", "hd_dep_count",
                                "hd_vehicle_count"],
     "customer_address": ["ca_address_sk", "ca_street_number",
                          "ca_street_name", "ca_city", "ca_zip",
                          "ca_state", "ca_country", "ca_county",
-                         "ca_gmt_offset"],
+                         "ca_gmt_offset", "ca_street_type",
+                         "ca_suite_number", "ca_location_type"],
     "income_band": ["ib_income_band_sk", "ib_lower_bound",
                     "ib_upper_bound"],
-    "promotion": ["p_promo_sk", "p_channel_email", "p_channel_event"],
+    "promotion": ["p_promo_sk", "p_channel_email", "p_channel_event",
+                  "p_channel_dmail", "p_channel_tv"],
     "web_sales": ["ws_sold_date_sk", "ws_sold_time_sk",
                   "ws_ship_date_sk", "ws_item_sk",
                   "ws_order_number", "ws_warehouse_sk",
@@ -92,13 +104,19 @@ _ORACLE_TABLES = {
                   "ws_ext_sales_price", "ws_ext_discount_amt",
                   "ws_ext_ship_cost", "ws_net_paid",
                   "ws_sales_price", "ws_ship_customer_sk",
-                  "ws_ext_list_price", "ws_net_profit"],
-    "warehouse": ["w_warehouse_sk", "w_warehouse_name"],
+                  "ws_ext_list_price", "ws_ext_wholesale_cost",
+                  "ws_quantity", "ws_net_profit"],
+    "warehouse": ["w_warehouse_sk", "w_warehouse_name", "w_state"],
     "ship_mode": ["sm_ship_mode_sk", "sm_type"],
     "web_site": ["web_site_sk", "web_name", "web_company_name"],
     "web_page": ["wp_web_page_sk", "wp_char_count"],
     "web_returns": ["wr_item_sk", "wr_order_number",
-                    "wr_returned_date_sk"],
+                    "wr_returned_date_sk",
+                    "wr_returning_customer_sk", "wr_return_amt",
+                    "wr_return_quantity", "wr_refunded_cash",
+                    "wr_fee", "wr_returning_addr_sk",
+                    "wr_refunded_addr_sk", "wr_refunded_cdemo_sk",
+                    "wr_returning_cdemo_sk", "wr_reason_sk"],
     "call_center": ["cc_call_center_sk", "cc_call_center_id",
                     "cc_name", "cc_manager", "cc_county"],
     "time_dim": ["t_time_sk", "t_hour", "t_minute"],
@@ -306,9 +324,17 @@ WHERE d1.d_month_seq BETWEEN 1200 AND 1211
        WHERE ranking <= 5)
 """
 
+def _qualify_order_item_id(sql: str, tbl: str) -> str:
+    """sqlite calls the bare `ORDER BY item_id` ambiguous when several
+    FROM items expose item_id; the engine resolves it to the output
+    column per the standard. Qualify only on the oracle side."""
+    return sql.replace("ORDER BY item_id,", f"ORDER BY {tbl}.item_id,")
+
+
 _ORACLE_OVERRIDE = {
     48: _Q48_ORACLE,
     13: _Q13_ORACLE,
+    58: _qualify_order_item_id(TPCDS_QUERIES[58], "ss_items"),
     # sqlite has no ROLLUP: q70 expands to its 3 grouping levels
     70: f"""
 SELECT total_sum, s_state, s_county, lochierarchy,

@@ -1893,4 +1893,604 @@ FULL OUTER JOIN csci ON (ssci.customer_sk = csci.customer_sk
                          AND ssci.item_sk = csci.item_sk)
 LIMIT 100
 """,
+    4: """
+WITH year_total AS (
+  SELECT c_customer_id customer_id, c_first_name customer_first_name,
+         c_last_name customer_last_name,
+         c_preferred_cust_flag customer_preferred_cust_flag,
+         c_birth_country customer_birth_country, d_year dyear,
+         sum(((ss_ext_list_price - ss_ext_wholesale_cost
+               - ss_ext_discount_amt) + ss_ext_sales_price) / 2)
+             year_total,
+         's' sale_type
+  FROM customer, store_sales, date_dim
+  WHERE c_customer_sk = ss_customer_sk
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_country, d_year,
+         sum(((cs_ext_list_price - cs_ext_wholesale_cost
+               - cs_ext_discount_amt) + cs_ext_sales_price) / 2),
+         'c' sale_type
+  FROM customer, catalog_sales, date_dim
+  WHERE c_customer_sk = cs_bill_customer_sk
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year
+  UNION ALL
+  SELECT c_customer_id, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_country, d_year,
+         sum(((ws_ext_list_price - ws_ext_wholesale_cost
+               - ws_ext_discount_amt) + ws_ext_sales_price) / 2),
+         'w' sale_type
+  FROM customer, web_sales, date_dim
+  WHERE c_customer_sk = ws_bill_customer_sk
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY c_customer_id, c_first_name, c_last_name,
+           c_preferred_cust_flag, c_birth_country, d_year)
+SELECT t_s_secyear.customer_id, t_s_secyear.customer_first_name,
+       t_s_secyear.customer_last_name,
+       t_s_secyear.customer_preferred_cust_flag
+FROM year_total t_s_firstyear, year_total t_s_secyear,
+     year_total t_c_firstyear, year_total t_c_secyear,
+     year_total t_w_firstyear, year_total t_w_secyear
+WHERE t_s_secyear.customer_id = t_s_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_secyear.customer_id
+  AND t_s_firstyear.customer_id = t_c_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_firstyear.customer_id
+  AND t_s_firstyear.customer_id = t_w_secyear.customer_id
+  AND t_s_firstyear.sale_type = 's'
+  AND t_c_firstyear.sale_type = 'c'
+  AND t_w_firstyear.sale_type = 'w'
+  AND t_s_secyear.sale_type = 's'
+  AND t_c_secyear.sale_type = 'c'
+  AND t_w_secyear.sale_type = 'w'
+  AND t_s_firstyear.dyear = 2001
+  AND t_s_secyear.dyear = 2001 + 1
+  AND t_c_firstyear.dyear = 2001
+  AND t_c_secyear.dyear = 2001 + 1
+  AND t_w_firstyear.dyear = 2001
+  AND t_w_secyear.dyear = 2001 + 1
+  AND t_s_firstyear.year_total > 0
+  AND t_c_firstyear.year_total > 0
+  AND t_w_firstyear.year_total > 0
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_s_firstyear.year_total > 0
+             THEN t_s_secyear.year_total / t_s_firstyear.year_total
+             ELSE NULL END
+  AND CASE WHEN t_c_firstyear.year_total > 0
+           THEN t_c_secyear.year_total / t_c_firstyear.year_total
+           ELSE NULL END
+      > CASE WHEN t_w_firstyear.year_total > 0
+             THEN t_w_secyear.year_total / t_w_firstyear.year_total
+             ELSE NULL END
+ORDER BY t_s_secyear.customer_id,
+         t_s_secyear.customer_first_name,
+         t_s_secyear.customer_last_name,
+         t_s_secyear.customer_preferred_cust_flag
+LIMIT 100
+""",
+    10: """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       count(*) cnt1, cd_purchase_estimate, count(*) cnt2,
+       cd_credit_rating, count(*) cnt3,
+       cd_dep_count, count(*) cnt4,
+       cd_dep_employed_count, count(*) cnt5,
+       cd_dep_college_count, count(*) cnt6
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_county IN ('Williamson County', 'Ziebach County',
+                    'Walker County', 'Daviess County',
+                    'Barrow County')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_moy BETWEEN 1 AND 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_moy BETWEEN 1 AND 4))
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+LIMIT 100
+""",
+    30: """
+WITH customer_total_return AS (
+  SELECT wr_returning_customer_sk ctr_customer_sk,
+         ca_state ctr_state, sum(wr_return_amt) ctr_total_return
+  FROM web_returns, date_dim, customer_address
+  WHERE wr_returned_date_sk = d_date_sk AND d_year = 2002
+    AND wr_returning_addr_sk = ca_address_sk
+  GROUP BY wr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       c_preferred_cust_flag, c_birth_day, c_birth_month,
+       c_birth_year, c_birth_country, ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return
+      > (SELECT avg(ctr_total_return) * 1.2
+         FROM customer_total_return ctr2
+         WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk
+  AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+         c_preferred_cust_flag, c_birth_day, c_birth_month,
+         c_birth_year, c_birth_country, ctr_total_return
+LIMIT 100
+""",
+    35: """
+SELECT ca_state, cd_gender, cd_marital_status,
+       cd_dep_count, count(*) cnt1,
+       avg(cd_dep_count) a1, max(cd_dep_count) m1,
+       sum(cd_dep_count) s1,
+       cd_dep_employed_count, count(*) cnt2,
+       avg(cd_dep_employed_count) a2,
+       max(cd_dep_employed_count) m2,
+       sum(cd_dep_employed_count) s2,
+       cd_dep_college_count, count(*) cnt3,
+       avg(cd_dep_college_count) a3,
+       max(cd_dep_college_count) m3, sum(cd_dep_college_count) s3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2002 AND d_qoy < 4)
+  AND (EXISTS (SELECT * FROM web_sales, date_dim
+               WHERE c.c_customer_sk = ws_bill_customer_sk
+                 AND ws_sold_date_sk = d_date_sk
+                 AND d_year = 2002 AND d_qoy < 4)
+       OR EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2002 AND d_qoy < 4))
+GROUP BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+ORDER BY ca_state, cd_gender, cd_marital_status, cd_dep_count,
+         cd_dep_employed_count, cd_dep_college_count
+LIMIT 100
+""",
+    40: """
+SELECT w_state, i_item_id,
+       sum(CASE WHEN d_date < DATE '2000-03-11'
+                THEN cs_sales_price
+                     - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) sales_before,
+       sum(CASE WHEN d_date >= DATE '2000-03-11'
+                THEN cs_sales_price
+                     - coalesce(cr_refunded_cash, 0)
+                ELSE 0 END) sales_after
+FROM catalog_sales
+LEFT OUTER JOIN catalog_returns
+    ON (cs_order_number = cr_order_number
+        AND cs_item_sk = cr_item_sk),
+     warehouse, item, date_dim
+WHERE i_current_price BETWEEN 0.99 AND 1.49
+  AND i_item_sk = cs_item_sk
+  AND cs_warehouse_sk = w_warehouse_sk
+  AND cs_sold_date_sk = d_date_sk
+  AND d_date BETWEEN DATE '2000-02-10' AND DATE '2000-04-10'
+GROUP BY w_state, i_item_id
+ORDER BY w_state, i_item_id
+LIMIT 100
+""",
+    41: """
+SELECT DISTINCT i_product_name
+FROM item i1
+WHERE i_manufact_id BETWEEN 738 AND 778
+  AND (SELECT count(*) AS item_cnt
+       FROM item
+       WHERE (i_manufact = i1.i_manufact
+              AND ((i_category = 'Women'
+                    AND (i_color = 'powder' OR i_color = 'khaki')
+                    AND (i_units = 'Ounce' OR i_units = 'Each')
+                    AND (i_size = 'medium' OR i_size = 'extra large'))
+                   OR (i_category = 'Women'
+                       AND (i_color = 'brown' OR i_color = 'honeydew')
+                       AND (i_units = 'Bundle' OR i_units = 'Ton')
+                       AND (i_size = 'N/A' OR i_size = 'small'))
+                   OR (i_category = 'Men'
+                       AND (i_color = 'floral' OR i_color = 'deep')
+                       AND (i_units = 'Case' OR i_units = 'Dozen')
+                       AND (i_size = 'petite' OR i_size = 'large'))
+                   OR (i_category = 'Men'
+                       AND (i_color = 'light' OR i_color = 'cornflower')
+                       AND (i_units = 'Box' OR i_units = 'Pound')
+                       AND (i_size = 'medium'
+                            OR i_size = 'extra large'))))
+          OR (i_manufact = i1.i_manufact
+              AND ((i_category = 'Women'
+                    AND (i_color = 'midnight' OR i_color = 'snow')
+                    AND (i_units = 'Pallet' OR i_units = 'Gross')
+                    AND (i_size = 'medium' OR i_size = 'extra large'))
+                   OR (i_category = 'Women'
+                       AND (i_color = 'cyan' OR i_color = 'papaya')
+                       AND (i_units = 'Cup' OR i_units = 'Dram')
+                       AND (i_size = 'N/A' OR i_size = 'small'))
+                   OR (i_category = 'Men'
+                       AND (i_color = 'orange' OR i_color = 'frosted')
+                       AND (i_units = 'Each' OR i_units = 'Tbl')
+                       AND (i_size = 'petite' OR i_size = 'large'))
+                   OR (i_category = 'Men'
+                       AND (i_color = 'forest' OR i_color = 'ghost')
+                       AND (i_units = 'Lb' OR i_units = 'Bunch')
+                       AND (i_size = 'medium'
+                            OR i_size = 'extra large'))))) > 0
+ORDER BY i_product_name
+LIMIT 100
+""",
+    49: """
+SELECT channel, item, return_ratio, return_rank, currency_rank
+FROM (SELECT 'web' AS channel, web.item, web.return_ratio,
+             web.return_rank, web.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio)
+                       currency_rank
+            FROM (SELECT ws.ws_item_sk item,
+                         cast(sum(coalesce(wr.wr_return_quantity, 0))
+                              AS double)
+                         / cast(sum(coalesce(ws.ws_quantity, 0))
+                                AS double) return_ratio,
+                         cast(sum(coalesce(wr.wr_return_amt, 0))
+                              AS double)
+                         / cast(sum(coalesce(ws.ws_net_paid, 0))
+                                AS double) currency_ratio
+                  FROM web_sales ws
+                  LEFT OUTER JOIN web_returns wr
+                      ON (ws.ws_order_number = wr.wr_order_number
+                          AND ws.ws_item_sk = wr.wr_item_sk),
+                       date_dim
+                  WHERE wr.wr_return_amt > 100
+                    AND ws.ws_net_profit > 1
+                    AND ws.ws_net_paid > 0
+                    AND ws.ws_quantity > 0
+                    AND ws_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY ws.ws_item_sk) in_web) web
+      WHERE web.return_rank <= 10 OR web.currency_rank <= 10
+      UNION
+      SELECT 'catalog' AS channel, cat.item, cat.return_ratio,
+             cat.return_rank, cat.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio)
+                       currency_rank
+            FROM (SELECT cs.cs_item_sk item,
+                         cast(sum(coalesce(cr.cr_return_quantity, 0))
+                              AS double)
+                         / cast(sum(coalesce(cs.cs_quantity, 0))
+                                AS double) return_ratio,
+                         cast(sum(coalesce(cr.cr_return_amount, 0))
+                              AS double)
+                         / cast(sum(coalesce(cs.cs_net_paid, 0))
+                                AS double) currency_ratio
+                  FROM catalog_sales cs
+                  LEFT OUTER JOIN catalog_returns cr
+                      ON (cs.cs_order_number = cr.cr_order_number
+                          AND cs.cs_item_sk = cr.cr_item_sk),
+                       date_dim
+                  WHERE cr.cr_return_amount > 100
+                    AND cs.cs_net_profit > 1
+                    AND cs.cs_net_paid > 0
+                    AND cs.cs_quantity > 0
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY cs.cs_item_sk) in_cat) cat
+      WHERE cat.return_rank <= 10 OR cat.currency_rank <= 10
+      UNION
+      SELECT 'store' AS channel, sts.item, sts.return_ratio,
+             sts.return_rank, sts.currency_rank
+      FROM (SELECT item, return_ratio, currency_ratio,
+                   rank() OVER (ORDER BY return_ratio) return_rank,
+                   rank() OVER (ORDER BY currency_ratio)
+                       currency_rank
+            FROM (SELECT sts.ss_item_sk item,
+                         cast(sum(coalesce(sr.sr_return_quantity, 0))
+                              AS double)
+                         / cast(sum(coalesce(sts.ss_quantity, 0))
+                                AS double) return_ratio,
+                         cast(sum(coalesce(sr.sr_return_amt, 0))
+                              AS double)
+                         / cast(sum(coalesce(sts.ss_net_paid, 0))
+                                AS double) currency_ratio
+                  FROM store_sales sts
+                  LEFT OUTER JOIN store_returns sr
+                      ON (sts.ss_ticket_number = sr.sr_ticket_number
+                          AND sts.ss_item_sk = sr.sr_item_sk),
+                       date_dim
+                  WHERE sr.sr_return_amt > 100
+                    AND sts.ss_net_profit > 1
+                    AND sts.ss_net_paid > 0
+                    AND sts.ss_quantity > 0
+                    AND ss_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy = 12
+                  GROUP BY sts.ss_item_sk) in_store) sts
+      WHERE sts.return_rank <= 10 OR sts.currency_rank <= 10) t
+ORDER BY 1, 4, 5, 2
+LIMIT 100
+""",
+    58: """
+WITH ss_items AS (
+  SELECT i_item_id item_id, sum(ss_ext_sales_price) ss_item_rev
+  FROM store_sales, item, date_dim
+  WHERE ss_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq
+                                       FROM date_dim
+                                       WHERE d_date
+                                             = DATE '2000-01-03'))
+    AND ss_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+cs_items AS (
+  SELECT i_item_id item_id, sum(cs_ext_sales_price) cs_item_rev
+  FROM catalog_sales, item, date_dim
+  WHERE cs_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq
+                                       FROM date_dim
+                                       WHERE d_date
+                                             = DATE '2000-01-03'))
+    AND cs_sold_date_sk = d_date_sk
+  GROUP BY i_item_id),
+ws_items AS (
+  SELECT i_item_id item_id, sum(ws_ext_sales_price) ws_item_rev
+  FROM web_sales, item, date_dim
+  WHERE ws_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq = (SELECT d_week_seq
+                                       FROM date_dim
+                                       WHERE d_date
+                                             = DATE '2000-01-03'))
+    AND ws_sold_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT ss_items.item_id, ss_item_rev,
+       ss_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+           * 100 ss_dev,
+       cs_item_rev,
+       cs_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+           * 100 cs_dev,
+       ws_item_rev,
+       ws_item_rev / ((ss_item_rev + cs_item_rev + ws_item_rev) / 3)
+           * 100 ws_dev,
+       (ss_item_rev + cs_item_rev + ws_item_rev) / 3 average
+FROM ss_items, cs_items, ws_items
+WHERE ss_items.item_id = cs_items.item_id
+  AND ss_items.item_id = ws_items.item_id
+  AND ss_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+  AND ss_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND cs_item_rev BETWEEN 0.9 * ws_item_rev AND 1.1 * ws_item_rev
+  AND ws_item_rev BETWEEN 0.9 * ss_item_rev AND 1.1 * ss_item_rev
+  AND ws_item_rev BETWEEN 0.9 * cs_item_rev AND 1.1 * cs_item_rev
+ORDER BY item_id, ss_item_rev
+LIMIT 100
+""",
+    61: """
+SELECT promotions, total,
+       cast(promotions AS double) / cast(total AS double) * 100
+           ratio
+FROM (SELECT sum(ss_ext_sales_price) promotions
+      FROM store_sales, store, promotion, date_dim, customer,
+           customer_address, item
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_promo_sk = p_promo_sk
+        AND ss_customer_sk = c_customer_sk
+        AND ca_address_sk = c_current_addr_sk
+        AND ss_item_sk = i_item_sk
+        AND ca_gmt_offset = -5
+        AND i_category = 'Jewelry'
+        AND (p_channel_dmail = 'Y' OR p_channel_email = 'Y'
+             OR p_channel_tv = 'Y')
+        AND d_year = 1998 AND d_moy = 11) promotional_sales,
+     (SELECT sum(ss_ext_sales_price) total
+      FROM store_sales, store, date_dim, customer,
+           customer_address, item
+      WHERE ss_sold_date_sk = d_date_sk
+        AND ss_store_sk = s_store_sk
+        AND ss_customer_sk = c_customer_sk
+        AND ca_address_sk = c_current_addr_sk
+        AND ss_item_sk = i_item_sk
+        AND ca_gmt_offset = -5
+        AND i_category = 'Jewelry'
+        AND d_year = 1998 AND d_moy = 11) all_sales
+ORDER BY promotions, total
+LIMIT 100
+""",
+    69: """
+SELECT cd_gender, cd_marital_status, cd_education_status,
+       count(*) cnt1, cd_purchase_estimate, count(*) cnt2,
+       cd_credit_rating, count(*) cnt3
+FROM customer c, customer_address ca, customer_demographics
+WHERE c.c_current_addr_sk = ca.ca_address_sk
+  AND ca_state IN ('KY', 'GA', 'NM')
+  AND cd_demo_sk = c.c_current_cdemo_sk
+  AND EXISTS (SELECT * FROM store_sales, date_dim
+              WHERE c.c_customer_sk = ss_customer_sk
+                AND ss_sold_date_sk = d_date_sk
+                AND d_year = 2001 AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT * FROM web_sales, date_dim
+                  WHERE c.c_customer_sk = ws_bill_customer_sk
+                    AND ws_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy BETWEEN 4 AND 6)
+  AND NOT EXISTS (SELECT * FROM catalog_sales, date_dim
+                  WHERE c.c_customer_sk = cs_ship_customer_sk
+                    AND cs_sold_date_sk = d_date_sk
+                    AND d_year = 2001 AND d_moy BETWEEN 4 AND 6)
+GROUP BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+ORDER BY cd_gender, cd_marital_status, cd_education_status,
+         cd_purchase_estimate, cd_credit_rating
+LIMIT 100
+""",
+    81: """
+WITH customer_total_return AS (
+  SELECT cr_returning_customer_sk ctr_customer_sk,
+         ca_state ctr_state,
+         sum(cr_return_amt_inc_tax) ctr_total_return
+  FROM catalog_returns, date_dim, customer_address
+  WHERE cr_returned_date_sk = d_date_sk AND d_year = 2000
+    AND cr_returning_addr_sk = ca_address_sk
+  GROUP BY cr_returning_customer_sk, ca_state)
+SELECT c_customer_id, c_salutation, c_first_name, c_last_name,
+       ca_street_number, ca_street_name, ca_street_type,
+       ca_suite_number, ca_city, ca_county, ca_state, ca_zip,
+       ca_country, ca_gmt_offset, ca_location_type,
+       ctr_total_return
+FROM customer_total_return ctr1, customer_address, customer
+WHERE ctr1.ctr_total_return
+      > (SELECT avg(ctr_total_return) * 1.2
+         FROM customer_total_return ctr2
+         WHERE ctr1.ctr_state = ctr2.ctr_state)
+  AND ca_address_sk = c_current_addr_sk
+  AND ca_state = 'GA'
+  AND ctr1.ctr_customer_sk = c_customer_sk
+ORDER BY c_customer_id, c_salutation, c_first_name, c_last_name,
+         ca_street_number, ca_street_name, ca_street_type,
+         ca_suite_number, ca_city, ca_county, ca_state, ca_zip,
+         ca_country, ca_gmt_offset, ca_location_type,
+         ctr_total_return
+LIMIT 100
+""",
+    83: """
+WITH sr_items AS (
+  SELECT i_item_id item_id, sum(sr_return_quantity) sr_item_qty
+  FROM store_returns, item, date_dim
+  WHERE sr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND sr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+cr_items AS (
+  SELECT i_item_id item_id, sum(cr_return_quantity) cr_item_qty
+  FROM catalog_returns, item, date_dim
+  WHERE cr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND cr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id),
+wr_items AS (
+  SELECT i_item_id item_id, sum(wr_return_quantity) wr_item_qty
+  FROM web_returns, item, date_dim
+  WHERE wr_item_sk = i_item_sk
+    AND d_date IN (SELECT d_date FROM date_dim
+                   WHERE d_week_seq IN
+                         (SELECT d_week_seq FROM date_dim
+                          WHERE d_date IN (DATE '2000-06-30',
+                                           DATE '2000-09-27',
+                                           DATE '2000-11-17')))
+    AND wr_returned_date_sk = d_date_sk
+  GROUP BY i_item_id)
+SELECT sr_items.item_id,
+       sr_item_qty,
+       sr_item_qty * 1.0000
+           / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0000
+           * 100 sr_dev,
+       cr_item_qty,
+       cr_item_qty * 1.0000
+           / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0000
+           * 100 cr_dev,
+       wr_item_qty,
+       wr_item_qty * 1.0000
+           / (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0000
+           * 100 wr_dev,
+       (sr_item_qty + cr_item_qty + wr_item_qty) / 3.0 average
+FROM sr_items, cr_items, wr_items
+WHERE sr_items.item_id = cr_items.item_id
+  AND sr_items.item_id = wr_items.item_id
+ORDER BY sr_items.item_id, sr_item_qty
+LIMIT 100
+""",
+    85: """
+SELECT substr(r_reason_desc, 1, 20) reason,
+       avg(ws_quantity) q, avg(wr_refunded_cash) rc,
+       avg(wr_fee) fee
+FROM web_sales, web_returns, web_page, customer_demographics cd1,
+     customer_demographics cd2, customer_address, date_dim, reason
+WHERE ws_web_page_sk = wp_web_page_sk
+  AND ws_item_sk = wr_item_sk
+  AND ws_order_number = wr_order_number
+  AND ws_sold_date_sk = d_date_sk AND d_year = 2000
+  AND cd1.cd_demo_sk = wr_refunded_cdemo_sk
+  AND cd2.cd_demo_sk = wr_returning_cdemo_sk
+  AND ca_address_sk = wr_refunded_addr_sk
+  AND r_reason_sk = wr_reason_sk
+  AND ((cd1.cd_marital_status = 'M'
+        AND cd1.cd_marital_status = cd2.cd_marital_status
+        AND cd1.cd_education_status = 'Advanced Degree'
+        AND cd1.cd_education_status = cd2.cd_education_status
+        AND ws_sales_price BETWEEN 100.00 AND 150.00)
+       OR (cd1.cd_marital_status = 'S'
+           AND cd1.cd_marital_status = cd2.cd_marital_status
+           AND cd1.cd_education_status = 'College'
+           AND cd1.cd_education_status = cd2.cd_education_status
+           AND ws_sales_price BETWEEN 50.00 AND 100.00)
+       OR (cd1.cd_marital_status = 'W'
+           AND cd1.cd_marital_status = cd2.cd_marital_status
+           AND cd1.cd_education_status = '2 yr Degree'
+           AND cd1.cd_education_status = cd2.cd_education_status
+           AND ws_sales_price BETWEEN 150.00 AND 200.00))
+  AND ((ca_country = 'United States'
+        AND ca_state IN ('IN', 'OH', 'NJ')
+        AND ws_net_profit BETWEEN 100 AND 200)
+       OR (ca_country = 'United States'
+           AND ca_state IN ('WI', 'CT', 'KY')
+           AND ws_net_profit BETWEEN 150 AND 300)
+       OR (ca_country = 'United States'
+           AND ca_state IN ('LA', 'IA', 'AR')
+           AND ws_net_profit BETWEEN 50 AND 250))
+GROUP BY r_reason_desc
+ORDER BY substr(r_reason_desc, 1, 20), avg(ws_quantity),
+         avg(wr_refunded_cash), avg(wr_fee)
+LIMIT 100
+""",
+    95: """
+WITH ws_wh AS (
+  SELECT ws1.ws_order_number,
+         ws1.ws_warehouse_sk wh1, ws2.ws_warehouse_sk wh2
+  FROM web_sales ws1, web_sales ws2
+  WHERE ws1.ws_order_number = ws2.ws_order_number
+    AND ws1.ws_warehouse_sk <> ws2.ws_warehouse_sk)
+SELECT count(DISTINCT ws_order_number) order_count,
+       sum(ws_ext_ship_cost) total_shipping_cost,
+       sum(ws_net_profit) total_net_profit
+FROM web_sales ws1, date_dim, customer_address, web_site
+WHERE d_date BETWEEN DATE '1999-02-01' AND DATE '1999-04-02'
+  AND ws1.ws_ship_date_sk = d_date_sk
+  AND ws1.ws_ship_addr_sk = ca_address_sk
+  AND ca_state = 'IL'
+  AND ws1.ws_web_site_sk = web_site_sk
+  AND web_company_name = 'pri'
+  AND ws1.ws_order_number IN (SELECT ws_order_number
+                              FROM ws_wh)
+  AND ws1.ws_order_number IN (SELECT wr_order_number
+                              FROM web_returns, ws_wh
+                              WHERE wr_order_number
+                                    = ws_wh.ws_order_number)
+ORDER BY count(DISTINCT ws_order_number)
+LIMIT 100
+""",
 }

@@ -1125,6 +1125,18 @@ class TpcdsConnector(Connector):
         if "wr_refunded_cash" in need:
             cols["wr_refunded_cash"] = Column(
                 DOUBLE, _price(S + 9, idx, 0.0, 200.0), None)
+        if "wr_fee" in need:
+            cols["wr_fee"] = Column(
+                DOUBLE, _price(S + 10, idx, 0.5, 100.0), None)
+        for cname, sref, tbl in (
+                ("wr_returning_addr_sk", 11, "customer_address"),
+                ("wr_refunded_addr_sk", 12, "customer_address"),
+                ("wr_refunded_cdemo_sk", 13, "customer_demographics"),
+                ("wr_returning_cdemo_sk", 14,
+                 "customer_demographics")):
+            if cname in need:
+                k, v = _fk(S + sref, idx, table_rows(tbl, sf), 0.02)
+                cols[cname] = Column(BIGINT, k, v)
         return self._finish(cols, n, columns)
 
     def _catalog_returns(self, idx, sf, columns) -> Batch:
@@ -1171,6 +1183,13 @@ class TpcdsConnector(Connector):
         if "cr_reason_sk" in need:
             k, v = _fk(S + 12, idx, table_rows("reason", sf), 0.02)
             cols["cr_reason_sk"] = Column(BIGINT, k, v)
+        if "cr_return_amt_inc_tax" in need:
+            cols["cr_return_amt_inc_tax"] = Column(
+                DOUBLE, _price(S + 13, idx, 1.0, 320.0), None)
+        if "cr_returning_addr_sk" in need:
+            k, v = _fk(S + 14, idx,
+                       table_rows("customer_address", sf), 0.02)
+            cols["cr_returning_addr_sk"] = Column(BIGINT, k, v)
         return self._finish(cols, n, columns)
 
 
@@ -1313,7 +1332,9 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("cr_returning_customer_sk", BIGINT),
         _cm("cr_call_center_sk", BIGINT),
         _cm("cr_catalog_page_sk", BIGINT),
-        _cm("cr_reason_sk", BIGINT)],
+        _cm("cr_reason_sk", BIGINT),
+        _cm("cr_return_amt_inc_tax", DOUBLE),
+        _cm("cr_returning_addr_sk", BIGINT)],
     "web_sales": [
         _cm("ws_sold_date_sk", BIGINT), _cm("ws_sold_time_sk", BIGINT),
         _cm("ws_ship_date_sk", BIGINT), _cm("ws_item_sk", BIGINT),
@@ -1343,7 +1364,11 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("wr_web_page_sk", BIGINT), _cm("wr_reason_sk", BIGINT),
         _cm("wr_return_quantity", BIGINT),
         _cm("wr_return_amt", DOUBLE), _cm("wr_net_loss", DOUBLE),
-        _cm("wr_refunded_cash", DOUBLE)],
+        _cm("wr_refunded_cash", DOUBLE), _cm("wr_fee", DOUBLE),
+        _cm("wr_returning_addr_sk", BIGINT),
+        _cm("wr_refunded_addr_sk", BIGINT),
+        _cm("wr_refunded_cdemo_sk", BIGINT),
+        _cm("wr_returning_cdemo_sk", BIGINT)],
     "web_site": [
         _cm("web_site_sk", BIGINT), _cm("web_site_id", _V(16)),
         _cm("web_name", _V(50)), _cm("web_company_name", _V(50))],

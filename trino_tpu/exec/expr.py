@@ -335,12 +335,23 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
         return _to_varchar(src, t)
     d = _lane(src)
     if isinstance(s, DecimalType):
-        sv = d.astype(jnp.float64) / (10.0 ** s.scale)
+        if src.data2 is not None:
+            # fold the Int128 hi lane in: value = hi*2^64 + u64(lo)
+            # (float64 rounding is inherent in a cast to double)
+            lo = d.astype(jnp.float64)
+            lo = jnp.where(d < 0, lo + 2.0 ** 64, lo)
+            sv = (jnp.asarray(src.data2).astype(jnp.float64)
+                  * 2.0 ** 64 + lo) / (10.0 ** s.scale)
+        else:
+            sv = d.astype(jnp.float64) / (10.0 ** s.scale)
         if t.name == "double":
             return Column(t, sv, src.valid)
         if t.name == "real":
             return Column(t, sv.astype(jnp.float32), src.valid)
         if is_integral(t):
+            if src.data2 is not None:
+                raise EvalError(
+                    "DECIMAL(p>18) to integer cast not supported yet")
             return Column(t, _round_half_up(sv).astype(t.np_dtype),
                           src.valid)
         if isinstance(t, DecimalType):

@@ -2125,7 +2125,11 @@ def _strip_correlated_filters(node: PlanNode, corr: Set[str],
         if isinstance(n, FilterNode):
             src = visit(n.source)
             keep: List[RowExpr] = []
-            for c in rex.split_conjuncts(n.predicate):
+            # normalize (A and X) or (A and Y) -> A and (X or Y) first:
+            # q41-style subqueries repeat the correlated conjunct inside
+            # every OR arm, and only the factored form decorrelates
+            from .optimizer import _split_normalized
+            for c in _split_normalized(n.predicate):
                 refs = rex.input_names(c)
                 if refs & corr:
                     pair = _as_correlation_pair(c, corr)
