@@ -2493,4 +2493,845 @@ WHERE d_date BETWEEN DATE '1999-02-01' AND DATE '1999-04-02'
 ORDER BY count(DISTINCT ws_order_number)
 LIMIT 100
 """,
+    5: """
+WITH ssr AS (
+  SELECT s_store_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_, sum(net_loss) profit_loss
+  FROM (SELECT ss_store_sk AS store_sk,
+               ss_sold_date_sk AS date_sk,
+               ss_ext_sales_price AS sales_price,
+               ss_net_profit AS profit,
+               cast(0 AS double) AS return_amt,
+               cast(0 AS double) AS net_loss
+        FROM store_sales
+        UNION ALL
+        SELECT sr_store_sk, sr_returned_date_sk,
+               cast(0 AS double), cast(0 AS double),
+               sr_return_amt, sr_net_loss
+        FROM store_returns) salesreturns,
+       date_dim, store
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-06'
+    AND store_sk = s_store_sk
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_, sum(net_loss) profit_loss
+  FROM (SELECT cs_catalog_page_sk AS page_sk,
+               cs_sold_date_sk AS date_sk,
+               cs_ext_sales_price AS sales_price,
+               cs_net_profit AS profit,
+               cast(0 AS double) AS return_amt,
+               cast(0 AS double) AS net_loss
+        FROM catalog_sales
+        UNION ALL
+        SELECT cr_catalog_page_sk, cr_returned_date_sk,
+               cast(0 AS double), cast(0 AS double),
+               cr_return_amount, cr_net_loss
+        FROM catalog_returns) salesreturns,
+       date_dim, catalog_page
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-06'
+    AND page_sk = cp_catalog_page_sk
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id,
+         sum(sales_price) sales, sum(profit) profit,
+         sum(return_amt) returns_, sum(net_loss) profit_loss
+  FROM (SELECT ws_web_site_sk AS wsr_web_site_sk,
+               ws_sold_date_sk AS date_sk,
+               ws_ext_sales_price AS sales_price,
+               ws_net_profit AS profit,
+               cast(0 AS double) AS return_amt,
+               cast(0 AS double) AS net_loss
+        FROM web_sales
+        UNION ALL
+        SELECT ws_web_site_sk, wr_returned_date_sk,
+               cast(0 AS double), cast(0 AS double),
+               wr_return_amt, wr_net_loss
+        FROM web_returns
+        LEFT OUTER JOIN web_sales
+            ON (wr_item_sk = ws_item_sk
+                AND wr_order_number = ws_order_number)) salesreturns,
+       date_dim, web_site
+  WHERE date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-06'
+    AND wsr_web_site_sk = web_site_sk
+  GROUP BY web_site_id)
+SELECT channel, id, sum(sales) sales, sum(returns_) returns_,
+       sum(profit - profit_loss) profit
+FROM (SELECT 'store channel' AS channel,
+             'store' || s_store_id AS id,
+             sales, returns_, profit, profit_loss
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel', 'catalog_page' || cp_catalog_page_id,
+             sales, returns_, profit, profit_loss
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', 'web_site' || web_site_id,
+             sales, returns_, profit, profit_loss
+      FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST
+LIMIT 100
+""",
+    14: """
+WITH cross_items AS (
+  SELECT i_item_sk ss_item_sk
+  FROM item,
+       (SELECT iss.i_brand_id brand_id, iss.i_class_id class_id,
+               iss.i_category_id category_id
+        FROM store_sales, item iss, date_dim d1
+        WHERE ss_item_sk = iss.i_item_sk
+          AND ss_sold_date_sk = d1.d_date_sk
+          AND d1.d_year BETWEEN 1999 AND 1999 + 2
+        INTERSECT
+        SELECT ics.i_brand_id, ics.i_class_id, ics.i_category_id
+        FROM catalog_sales, item ics, date_dim d2
+        WHERE cs_item_sk = ics.i_item_sk
+          AND cs_sold_date_sk = d2.d_date_sk
+          AND d2.d_year BETWEEN 1999 AND 1999 + 2
+        INTERSECT
+        SELECT iws.i_brand_id, iws.i_class_id, iws.i_category_id
+        FROM web_sales, item iws, date_dim d3
+        WHERE ws_item_sk = iws.i_item_sk
+          AND ws_sold_date_sk = d3.d_date_sk
+          AND d3.d_year BETWEEN 1999 AND 1999 + 2) t
+  WHERE i_brand_id = brand_id
+    AND i_class_id = class_id
+    AND i_category_id = category_id),
+avg_sales AS (
+  SELECT avg(quantity * list_price) average_sales
+  FROM (SELECT ss_quantity quantity, ss_list_price list_price
+        FROM store_sales, date_dim
+        WHERE ss_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 1999 + 2
+        UNION ALL
+        SELECT cs_quantity, cs_list_price
+        FROM catalog_sales, date_dim
+        WHERE cs_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 1999 + 2
+        UNION ALL
+        SELECT ws_quantity, ws_list_price
+        FROM web_sales, date_dim
+        WHERE ws_sold_date_sk = d_date_sk
+          AND d_year BETWEEN 1999 AND 1999 + 2) x)
+SELECT channel, i_brand_id, i_class_id, i_category_id,
+       sum(sales) sum_sales, sum(number_sales) sum_number_sales
+FROM (SELECT 'store' channel, i_brand_id, i_class_id,
+             i_category_id, sum(ss_quantity * ss_list_price) sales,
+             count(*) number_sales
+      FROM store_sales, item, date_dim
+      WHERE ss_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ss_item_sk = i_item_sk
+        AND ss_sold_date_sk = d_date_sk
+        AND d_year = 1999 + 2 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ss_quantity * ss_list_price)
+             > (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'catalog', i_brand_id, i_class_id, i_category_id,
+             sum(cs_quantity * cs_list_price), count(*)
+      FROM catalog_sales, item, date_dim
+      WHERE cs_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND cs_item_sk = i_item_sk
+        AND cs_sold_date_sk = d_date_sk
+        AND d_year = 1999 + 2 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(cs_quantity * cs_list_price)
+             > (SELECT average_sales FROM avg_sales)
+      UNION ALL
+      SELECT 'web', i_brand_id, i_class_id, i_category_id,
+             sum(ws_quantity * ws_list_price), count(*)
+      FROM web_sales, item, date_dim
+      WHERE ws_item_sk IN (SELECT ss_item_sk FROM cross_items)
+        AND ws_item_sk = i_item_sk
+        AND ws_sold_date_sk = d_date_sk
+        AND d_year = 1999 + 2 AND d_moy = 11
+      GROUP BY i_brand_id, i_class_id, i_category_id
+      HAVING sum(ws_quantity * ws_list_price)
+             > (SELECT average_sales FROM avg_sales)) y
+GROUP BY ROLLUP (channel, i_brand_id, i_class_id, i_category_id)
+ORDER BY channel NULLS LAST, i_brand_id NULLS LAST,
+         i_class_id NULLS LAST, i_category_id NULLS LAST
+LIMIT 100
+""",
+    23: """
+WITH frequent_ss_items AS (
+  SELECT substr(i_item_desc, 1, 30) itemdesc, i_item_sk item_sk,
+         d_date solddate, count(*) cnt
+  FROM store_sales, date_dim, item
+  WHERE ss_sold_date_sk = d_date_sk
+    AND ss_item_sk = i_item_sk
+    AND d_year IN (2000, 2000 + 1, 2000 + 2, 2000 + 3)
+  GROUP BY substr(i_item_desc, 1, 30), i_item_sk, d_date
+  HAVING count(*) > 4),
+max_store_sales AS (
+  SELECT max(csales) tpcds_cmax
+  FROM (SELECT c_customer_sk,
+               sum(ss_quantity * ss_sales_price) csales
+        FROM store_sales, customer, date_dim
+        WHERE ss_customer_sk = c_customer_sk
+          AND ss_sold_date_sk = d_date_sk
+          AND d_year IN (2000, 2000 + 1, 2000 + 2, 2000 + 3)
+        GROUP BY c_customer_sk) x),
+best_ss_customer AS (
+  SELECT c_customer_sk,
+         sum(ss_quantity * ss_sales_price) ssales
+  FROM store_sales, customer
+  WHERE ss_customer_sk = c_customer_sk
+  GROUP BY c_customer_sk
+  HAVING sum(ss_quantity * ss_sales_price)
+         > 0.5 * (SELECT tpcds_cmax FROM max_store_sales))
+SELECT sum(sales) total
+FROM (SELECT cs_quantity * cs_list_price sales
+      FROM catalog_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2
+        AND cs_sold_date_sk = d_date_sk
+        AND cs_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND cs_bill_customer_sk IN (SELECT c_customer_sk
+                                    FROM best_ss_customer)
+      UNION ALL
+      SELECT ws_quantity * ws_list_price sales
+      FROM web_sales, date_dim
+      WHERE d_year = 2000 AND d_moy = 2
+        AND ws_sold_date_sk = d_date_sk
+        AND ws_item_sk IN (SELECT item_sk FROM frequent_ss_items)
+        AND ws_bill_customer_sk IN (SELECT c_customer_sk
+                                    FROM best_ss_customer)) t
+LIMIT 100
+""",
+    24: """
+WITH ssales AS (
+  SELECT c_last_name, c_first_name, s_store_name, ca_state,
+         s_state, i_color, i_current_price, i_manager_id,
+         i_units, i_size, sum(ss_net_paid) netpaid
+  FROM store_sales, store_returns, store, item, customer,
+       customer_address
+  WHERE ss_ticket_number = sr_ticket_number
+    AND ss_item_sk = sr_item_sk
+    AND ss_customer_sk = c_customer_sk
+    AND ss_item_sk = i_item_sk
+    AND ss_store_sk = s_store_sk
+    AND c_current_addr_sk = ca_address_sk
+    AND c_birth_country <> upper(ca_country)
+    AND s_zip = ca_zip
+    AND s_market_id = 8
+  GROUP BY c_last_name, c_first_name, s_store_name, ca_state,
+           s_state, i_color, i_current_price, i_manager_id,
+           i_units, i_size)
+SELECT c_last_name, c_first_name, s_store_name,
+       sum(netpaid) paid
+FROM ssales
+WHERE i_color = 'pale'
+GROUP BY c_last_name, c_first_name, s_store_name
+HAVING sum(netpaid) > (SELECT 0.05 * avg(netpaid) FROM ssales)
+ORDER BY c_last_name, c_first_name, s_store_name
+""",
+    39: """
+WITH inv AS (
+  SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+         stdev, mean,
+         CASE mean WHEN 0 THEN NULL ELSE stdev / mean END cov
+  FROM (SELECT w_warehouse_name, w_warehouse_sk, i_item_sk, d_moy,
+               stddev_samp(inv_quantity_on_hand) stdev,
+               avg(inv_quantity_on_hand) mean
+        FROM inventory, item, warehouse, date_dim
+        WHERE inv_item_sk = i_item_sk
+          AND inv_warehouse_sk = w_warehouse_sk
+          AND inv_date_sk = d_date_sk
+          AND d_year = 2001
+        GROUP BY w_warehouse_name, w_warehouse_sk, i_item_sk,
+                 d_moy) foo
+  WHERE CASE mean WHEN 0 THEN 0 ELSE stdev / mean END > 1)
+SELECT inv1.w_warehouse_sk wsk1, inv1.i_item_sk isk1,
+       inv1.d_moy moy1, inv1.mean mean1, inv1.cov cov1,
+       inv2.w_warehouse_sk wsk2, inv2.i_item_sk isk2,
+       inv2.d_moy moy2, inv2.mean mean2, inv2.cov cov2
+FROM inv inv1, inv inv2
+WHERE inv1.i_item_sk = inv2.i_item_sk
+  AND inv1.w_warehouse_sk = inv2.w_warehouse_sk
+  AND inv1.d_moy = 1
+  AND inv2.d_moy = 1 + 1
+ORDER BY wsk1, isk1, moy1, mean1, cov1, mean2, cov2
+""",
+    44: """
+SELECT asceding.rnk, i1.i_product_name best_performing,
+       i2.i_product_name worst_performing
+FROM (SELECT *
+      FROM (SELECT item_sk,
+                   rank() OVER (ORDER BY rank_col ASC) rnk
+            FROM (SELECT ss_item_sk item_sk,
+                         avg(ss_net_profit) rank_col
+                  FROM store_sales ss1
+                  WHERE ss_store_sk = 2
+                  GROUP BY ss_item_sk
+                  HAVING avg(ss_net_profit)
+                         > 0.9 * (SELECT avg(ss_net_profit)
+                                  FROM store_sales
+                                  WHERE ss_store_sk = 2
+                                    AND ss_addr_sk IS NULL)) v1) v11
+      WHERE rnk < 11) asceding,
+     (SELECT *
+      FROM (SELECT item_sk,
+                   rank() OVER (ORDER BY rank_col DESC) rnk
+            FROM (SELECT ss_item_sk item_sk,
+                         avg(ss_net_profit) rank_col
+                  FROM store_sales ss1
+                  WHERE ss_store_sk = 2
+                  GROUP BY ss_item_sk
+                  HAVING avg(ss_net_profit)
+                         > 0.9 * (SELECT avg(ss_net_profit)
+                                  FROM store_sales
+                                  WHERE ss_store_sk = 2
+                                    AND ss_addr_sk IS NULL)) v2) v21
+      WHERE rnk < 11) descending,
+     item i1, item i2
+WHERE asceding.rnk = descending.rnk
+  AND i1.i_item_sk = asceding.item_sk
+  AND i2.i_item_sk = descending.item_sk
+ORDER BY asceding.rnk
+""",
+    54: """
+WITH my_customers AS (
+  SELECT DISTINCT c_customer_sk, c_current_addr_sk
+  FROM (SELECT cs_sold_date_sk sold_date_sk,
+               cs_bill_customer_sk customer_sk,
+               cs_item_sk item_sk
+        FROM catalog_sales
+        UNION ALL
+        SELECT ws_sold_date_sk, ws_bill_customer_sk, ws_item_sk
+        FROM web_sales) cs_or_ws_sales,
+       item, date_dim, customer
+  WHERE sold_date_sk = d_date_sk
+    AND item_sk = i_item_sk
+    AND i_category = 'Women'
+    AND i_class = 'class#1'
+    AND c_customer_sk = cs_or_ws_sales.customer_sk
+    AND d_moy = 12 AND d_year = 1998),
+my_revenue AS (
+  SELECT c_customer_sk, sum(ss_ext_sales_price) revenue
+  FROM my_customers, store_sales, customer_address, store,
+       date_dim
+  WHERE c_current_addr_sk = ca_address_sk
+    AND ca_county = s_county
+    AND ca_state = s_state
+    AND ss_sold_date_sk = d_date_sk
+    AND c_customer_sk = ss_customer_sk
+    AND d_month_seq BETWEEN (SELECT DISTINCT d_month_seq + 1
+                             FROM date_dim
+                             WHERE d_year = 1998 AND d_moy = 12)
+                        AND (SELECT DISTINCT d_month_seq + 3
+                             FROM date_dim
+                             WHERE d_year = 1998 AND d_moy = 12)
+  GROUP BY c_customer_sk),
+segments AS (
+  SELECT cast(revenue / 50 AS bigint) segment
+  FROM my_revenue)
+SELECT segment, count(*) num_customers,
+       segment * 50 segment_base
+FROM segments
+GROUP BY segment
+ORDER BY segment, num_customers
+LIMIT 100
+""",
+    66: """
+SELECT w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+       w_state, w_country, ship_carriers, year_,
+       sum(jan_sales) jan_sales, sum(feb_sales) feb_sales,
+       sum(mar_sales) mar_sales, sum(apr_sales) apr_sales,
+       sum(may_sales) may_sales, sum(jun_sales) jun_sales,
+       sum(jul_sales) jul_sales, sum(aug_sales) aug_sales,
+       sum(sep_sales) sep_sales, sum(oct_sales) oct_sales,
+       sum(nov_sales) nov_sales, sum(dec_sales) dec_sales,
+       sum(jan_net) jan_net, sum(feb_net) feb_net,
+       sum(mar_net) mar_net, sum(apr_net) apr_net,
+       sum(may_net) may_net, sum(jun_net) jun_net,
+       sum(jul_net) jul_net, sum(aug_net) aug_net,
+       sum(sep_net) sep_net, sum(oct_net) oct_net,
+       sum(nov_net) nov_net, sum(dec_net) dec_net
+FROM (SELECT w_warehouse_name, w_warehouse_sq_ft, w_city,
+             w_county, w_state, w_country,
+             'DHL' || ',' || 'BARIAN' AS ship_carriers,
+             d_year AS year_,
+             sum(CASE WHEN d_moy = 1 THEN ws_ext_sales_price
+                      ELSE 0 END) AS jan_sales,
+             sum(CASE WHEN d_moy = 2 THEN ws_ext_sales_price
+                      ELSE 0 END) AS feb_sales,
+             sum(CASE WHEN d_moy = 3 THEN ws_ext_sales_price
+                      ELSE 0 END) AS mar_sales,
+             sum(CASE WHEN d_moy = 4 THEN ws_ext_sales_price
+                      ELSE 0 END) AS apr_sales,
+             sum(CASE WHEN d_moy = 5 THEN ws_ext_sales_price
+                      ELSE 0 END) AS may_sales,
+             sum(CASE WHEN d_moy = 6 THEN ws_ext_sales_price
+                      ELSE 0 END) AS jun_sales,
+             sum(CASE WHEN d_moy = 7 THEN ws_ext_sales_price
+                      ELSE 0 END) AS jul_sales,
+             sum(CASE WHEN d_moy = 8 THEN ws_ext_sales_price
+                      ELSE 0 END) AS aug_sales,
+             sum(CASE WHEN d_moy = 9 THEN ws_ext_sales_price
+                      ELSE 0 END) AS sep_sales,
+             sum(CASE WHEN d_moy = 10 THEN ws_ext_sales_price
+                      ELSE 0 END) AS oct_sales,
+             sum(CASE WHEN d_moy = 11 THEN ws_ext_sales_price
+                      ELSE 0 END) AS nov_sales,
+             sum(CASE WHEN d_moy = 12 THEN ws_ext_sales_price
+                      ELSE 0 END) AS dec_sales,
+             sum(CASE WHEN d_moy = 1 THEN ws_net_paid
+                      ELSE 0 END) AS jan_net,
+             sum(CASE WHEN d_moy = 2 THEN ws_net_paid
+                      ELSE 0 END) AS feb_net,
+             sum(CASE WHEN d_moy = 3 THEN ws_net_paid
+                      ELSE 0 END) AS mar_net,
+             sum(CASE WHEN d_moy = 4 THEN ws_net_paid
+                      ELSE 0 END) AS apr_net,
+             sum(CASE WHEN d_moy = 5 THEN ws_net_paid
+                      ELSE 0 END) AS may_net,
+             sum(CASE WHEN d_moy = 6 THEN ws_net_paid
+                      ELSE 0 END) AS jun_net,
+             sum(CASE WHEN d_moy = 7 THEN ws_net_paid
+                      ELSE 0 END) AS jul_net,
+             sum(CASE WHEN d_moy = 8 THEN ws_net_paid
+                      ELSE 0 END) AS aug_net,
+             sum(CASE WHEN d_moy = 9 THEN ws_net_paid
+                      ELSE 0 END) AS sep_net,
+             sum(CASE WHEN d_moy = 10 THEN ws_net_paid
+                      ELSE 0 END) AS oct_net,
+             sum(CASE WHEN d_moy = 11 THEN ws_net_paid
+                      ELSE 0 END) AS nov_net,
+             sum(CASE WHEN d_moy = 12 THEN ws_net_paid
+                      ELSE 0 END) AS dec_net
+      FROM web_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE ws_warehouse_sk = w_warehouse_sk
+        AND ws_sold_date_sk = d_date_sk
+        AND ws_sold_time_sk = t_time_sk
+        AND ws_ship_mode_sk = sm_ship_mode_sk
+        AND d_year = 2001
+        AND t_time BETWEEN 30838 AND 30838 + 28800
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+               w_county, w_state, w_country, d_year
+      UNION ALL
+      SELECT w_warehouse_name, w_warehouse_sq_ft, w_city,
+             w_county, w_state, w_country,
+             'DHL' || ',' || 'BARIAN' AS ship_carriers,
+             d_year AS year_,
+             sum(CASE WHEN d_moy = 1 THEN cs_ext_sales_price
+                      ELSE 0 END) AS jan_sales,
+             sum(CASE WHEN d_moy = 2 THEN cs_ext_sales_price
+                      ELSE 0 END) AS feb_sales,
+             sum(CASE WHEN d_moy = 3 THEN cs_ext_sales_price
+                      ELSE 0 END) AS mar_sales,
+             sum(CASE WHEN d_moy = 4 THEN cs_ext_sales_price
+                      ELSE 0 END) AS apr_sales,
+             sum(CASE WHEN d_moy = 5 THEN cs_ext_sales_price
+                      ELSE 0 END) AS may_sales,
+             sum(CASE WHEN d_moy = 6 THEN cs_ext_sales_price
+                      ELSE 0 END) AS jun_sales,
+             sum(CASE WHEN d_moy = 7 THEN cs_ext_sales_price
+                      ELSE 0 END) AS jul_sales,
+             sum(CASE WHEN d_moy = 8 THEN cs_ext_sales_price
+                      ELSE 0 END) AS aug_sales,
+             sum(CASE WHEN d_moy = 9 THEN cs_ext_sales_price
+                      ELSE 0 END) AS sep_sales,
+             sum(CASE WHEN d_moy = 10 THEN cs_ext_sales_price
+                      ELSE 0 END) AS oct_sales,
+             sum(CASE WHEN d_moy = 11 THEN cs_ext_sales_price
+                      ELSE 0 END) AS nov_sales,
+             sum(CASE WHEN d_moy = 12 THEN cs_ext_sales_price
+                      ELSE 0 END) AS dec_sales,
+             sum(CASE WHEN d_moy = 1 THEN cs_net_paid
+                      ELSE 0 END) AS jan_net,
+             sum(CASE WHEN d_moy = 2 THEN cs_net_paid
+                      ELSE 0 END) AS feb_net,
+             sum(CASE WHEN d_moy = 3 THEN cs_net_paid
+                      ELSE 0 END) AS mar_net,
+             sum(CASE WHEN d_moy = 4 THEN cs_net_paid
+                      ELSE 0 END) AS apr_net,
+             sum(CASE WHEN d_moy = 5 THEN cs_net_paid
+                      ELSE 0 END) AS may_net,
+             sum(CASE WHEN d_moy = 6 THEN cs_net_paid
+                      ELSE 0 END) AS jun_net,
+             sum(CASE WHEN d_moy = 7 THEN cs_net_paid
+                      ELSE 0 END) AS jul_net,
+             sum(CASE WHEN d_moy = 8 THEN cs_net_paid
+                      ELSE 0 END) AS aug_net,
+             sum(CASE WHEN d_moy = 9 THEN cs_net_paid
+                      ELSE 0 END) AS sep_net,
+             sum(CASE WHEN d_moy = 10 THEN cs_net_paid
+                      ELSE 0 END) AS oct_net,
+             sum(CASE WHEN d_moy = 11 THEN cs_net_paid
+                      ELSE 0 END) AS nov_net,
+             sum(CASE WHEN d_moy = 12 THEN cs_net_paid
+                      ELSE 0 END) AS dec_net
+      FROM catalog_sales, warehouse, date_dim, time_dim, ship_mode
+      WHERE cs_warehouse_sk = w_warehouse_sk
+        AND cs_sold_date_sk = d_date_sk
+        AND cs_sold_time_sk = t_time_sk
+        AND cs_ship_mode_sk = sm_ship_mode_sk
+        AND d_year = 2001
+        AND t_time BETWEEN 30838 AND 30838 + 28800
+        AND sm_carrier IN ('DHL', 'BARIAN')
+      GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city,
+               w_county, w_state, w_country, d_year) x
+GROUP BY w_warehouse_name, w_warehouse_sq_ft, w_city, w_county,
+         w_state, w_country, ship_carriers, year_
+ORDER BY w_warehouse_name
+LIMIT 100
+""",
+    67: """
+SELECT *
+FROM (SELECT i_category, i_class, i_brand, i_product_name, d_year,
+             d_qoy, d_moy, s_store_id, sumsales,
+             rank() OVER (PARTITION BY i_category
+                          ORDER BY sumsales DESC) rk
+      FROM (SELECT i_category, i_class, i_brand, i_product_name,
+                   d_year, d_qoy, d_moy, s_store_id,
+                   sum(coalesce(ss_sales_price * ss_quantity, 0))
+                       sumsales
+            FROM store_sales, date_dim, store, item
+            WHERE ss_sold_date_sk = d_date_sk
+              AND ss_item_sk = i_item_sk
+              AND ss_store_sk = s_store_sk
+              AND d_month_seq BETWEEN 1200 AND 1211
+            GROUP BY ROLLUP (i_category, i_class, i_brand,
+                             i_product_name, d_year, d_qoy, d_moy,
+                             s_store_id)) dw1) dw2
+WHERE rk <= 100
+ORDER BY i_category NULLS LAST, i_class NULLS LAST,
+         i_brand NULLS LAST, i_product_name NULLS LAST,
+         d_year NULLS LAST, d_qoy NULLS LAST, d_moy NULLS LAST,
+         s_store_id NULLS LAST, sumsales, rk
+LIMIT 100
+""",
+    71: """
+SELECT i_brand_id brand_id, i_brand brand, t_hour, t_minute,
+       sum(ext_price) ext_price
+FROM item,
+     (SELECT ws_ext_sales_price AS ext_price,
+             ws_sold_date_sk AS sold_date_sk,
+             ws_item_sk AS sold_item_sk,
+             ws_sold_time_sk AS time_sk
+      FROM web_sales, date_dim
+      WHERE d_date_sk = ws_sold_date_sk
+        AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT cs_ext_sales_price, cs_sold_date_sk, cs_item_sk,
+             cs_sold_time_sk
+      FROM catalog_sales, date_dim
+      WHERE d_date_sk = cs_sold_date_sk
+        AND d_moy = 11 AND d_year = 1999
+      UNION ALL
+      SELECT ss_ext_sales_price, ss_sold_date_sk, ss_item_sk,
+             ss_sold_time_sk
+      FROM store_sales, date_dim
+      WHERE d_date_sk = ss_sold_date_sk
+        AND d_moy = 11 AND d_year = 1999) tmp,
+     time_dim
+WHERE sold_item_sk = i_item_sk
+  AND i_manager_id = 1
+  AND time_sk = t_time_sk
+  AND (t_meal_time = 'breakfast' OR t_meal_time = 'dinner')
+GROUP BY i_brand, i_brand_id, t_hour, t_minute
+ORDER BY ext_price DESC, i_brand_id
+""",
+    72: """
+SELECT i_item_desc, w_warehouse_name, d1.d_week_seq,
+       sum(CASE WHEN p_promo_sk IS NULL THEN 1 ELSE 0 END)
+           no_promo,
+       sum(CASE WHEN p_promo_sk IS NOT NULL THEN 1 ELSE 0 END)
+           promo,
+       count(*) total_cnt
+FROM catalog_sales
+JOIN inventory ON (cs_item_sk = inv_item_sk)
+JOIN warehouse ON (w_warehouse_sk = inv_warehouse_sk)
+JOIN item ON (i_item_sk = cs_item_sk)
+JOIN customer_demographics ON (cs_bill_cdemo_sk = cd_demo_sk)
+JOIN household_demographics ON (cs_bill_hdemo_sk = hd_demo_sk)
+JOIN date_dim d1 ON (cs_sold_date_sk = d1.d_date_sk)
+JOIN date_dim d2 ON (inv_date_sk = d2.d_date_sk)
+JOIN date_dim d3 ON (cs_ship_date_sk = d3.d_date_sk)
+LEFT OUTER JOIN promotion ON (cs_promo_sk = p_promo_sk)
+LEFT OUTER JOIN catalog_returns
+    ON (cr_item_sk = cs_item_sk
+        AND cr_order_number = cs_order_number)
+WHERE d1.d_week_seq = d2.d_week_seq
+  AND inv_quantity_on_hand < cs_quantity
+  AND d3.d_date > d1.d_date + interval '5' day
+  AND hd_buy_potential = '>10000'
+  AND d1.d_year = 1999
+  AND cd_marital_status = 'D'
+GROUP BY i_item_desc, w_warehouse_name, d1.d_week_seq
+ORDER BY total_cnt DESC, i_item_desc, w_warehouse_name,
+         d1.d_week_seq
+LIMIT 100
+""",
+    75: """
+WITH all_sales AS (
+  SELECT d_year, i_brand_id, i_class_id, i_category_id,
+         i_manufact_id,
+         sum(sales_cnt) sales_cnt, sum(sales_amt) sales_amt
+  FROM (SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               cs_quantity - coalesce(cr_return_quantity, 0)
+                   sales_cnt,
+               cs_ext_sales_price
+                   - coalesce(cr_return_amount, 0.0) sales_amt
+        FROM catalog_sales
+        JOIN item ON i_item_sk = cs_item_sk
+        JOIN date_dim ON d_date_sk = cs_sold_date_sk
+        LEFT JOIN catalog_returns
+            ON (cs_order_number = cr_order_number
+                AND cs_item_sk = cr_item_sk)
+        WHERE i_category = 'Books'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ss_quantity - coalesce(sr_return_quantity, 0),
+               ss_ext_sales_price - coalesce(sr_return_amt, 0.0)
+        FROM store_sales
+        JOIN item ON i_item_sk = ss_item_sk
+        JOIN date_dim ON d_date_sk = ss_sold_date_sk
+        LEFT JOIN store_returns
+            ON (ss_ticket_number = sr_ticket_number
+                AND ss_item_sk = sr_item_sk)
+        WHERE i_category = 'Books'
+        UNION
+        SELECT d_year, i_brand_id, i_class_id, i_category_id,
+               i_manufact_id,
+               ws_quantity - coalesce(wr_return_quantity, 0),
+               ws_ext_sales_price - coalesce(wr_return_amt, 0.0)
+        FROM web_sales
+        JOIN item ON i_item_sk = ws_item_sk
+        JOIN date_dim ON d_date_sk = ws_sold_date_sk
+        LEFT JOIN web_returns
+            ON (ws_order_number = wr_order_number
+                AND ws_item_sk = wr_item_sk)
+        WHERE i_category = 'Books') sales_detail
+  GROUP BY d_year, i_brand_id, i_class_id, i_category_id,
+           i_manufact_id)
+SELECT prev_yr.d_year prev_year, curr_yr.d_year year_,
+       curr_yr.i_brand_id, curr_yr.i_class_id,
+       curr_yr.i_category_id, curr_yr.i_manufact_id,
+       prev_yr.sales_cnt prev_yr_cnt,
+       curr_yr.sales_cnt curr_yr_cnt,
+       curr_yr.sales_cnt - prev_yr.sales_cnt sales_cnt_diff,
+       curr_yr.sales_amt - prev_yr.sales_amt sales_amt_diff
+FROM all_sales curr_yr, all_sales prev_yr
+WHERE curr_yr.i_brand_id = prev_yr.i_brand_id
+  AND curr_yr.i_class_id = prev_yr.i_class_id
+  AND curr_yr.i_category_id = prev_yr.i_category_id
+  AND curr_yr.i_manufact_id = prev_yr.i_manufact_id
+  AND curr_yr.d_year = 2002
+  AND prev_yr.d_year = 2002 - 1
+  AND cast(curr_yr.sales_cnt AS double)
+      / cast(prev_yr.sales_cnt AS double) < 0.9
+ORDER BY sales_cnt_diff, sales_amt_diff
+LIMIT 100
+""",
+    77: """
+WITH ss AS (
+  SELECT s_store_sk, sum(ss_ext_sales_price) sales,
+         sum(ss_net_profit) profit
+  FROM store_sales, date_dim, store
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND ss_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+sr AS (
+  SELECT s_store_sk, sum(sr_return_amt) returns_,
+         sum(sr_net_loss) profit_loss
+  FROM store_returns, date_dim, store
+  WHERE sr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND sr_store_sk = s_store_sk
+  GROUP BY s_store_sk),
+cs AS (
+  SELECT cs_call_center_sk, sum(cs_ext_sales_price) sales,
+         sum(cs_net_profit) profit
+  FROM catalog_sales, date_dim
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+  GROUP BY cs_call_center_sk),
+cr AS (
+  SELECT cr_call_center_sk, sum(cr_return_amount) returns_,
+         sum(cr_net_loss) profit_loss
+  FROM catalog_returns, date_dim
+  WHERE cr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+  GROUP BY cr_call_center_sk),
+ws AS (
+  SELECT wp_web_page_sk, sum(ws_ext_sales_price) sales,
+         sum(ws_net_profit) profit
+  FROM web_sales, date_dim, web_page
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND ws_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk),
+wr AS (
+  SELECT wp_web_page_sk, sum(wr_return_amt) returns_,
+         sum(wr_net_loss) profit_loss
+  FROM web_returns, date_dim, web_page
+  WHERE wr_returned_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND wr_web_page_sk = wp_web_page_sk
+  GROUP BY wp_web_page_sk)
+SELECT channel, id, sum(sales) sales, sum(returns_) returns_,
+       sum(profit) profit
+FROM (SELECT 'store channel' AS channel, ss.s_store_sk AS id,
+             sales, coalesce(returns_, 0) returns_,
+             profit - coalesce(profit_loss, 0) profit
+      FROM ss
+      LEFT JOIN sr ON ss.s_store_sk = sr.s_store_sk
+      UNION ALL
+      SELECT 'catalog channel', cs_call_center_sk,
+             sales, returns_, profit - profit_loss
+      FROM cs, cr
+      UNION ALL
+      SELECT 'web channel', ws.wp_web_page_sk,
+             sales, coalesce(returns_, 0),
+             profit - coalesce(profit_loss, 0)
+      FROM ws
+      LEFT JOIN wr ON ws.wp_web_page_sk = wr.wp_web_page_sk) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST, sales
+LIMIT 100
+""",
+    78: """
+WITH ws AS (
+  SELECT d_year AS ws_sold_year, ws_item_sk,
+         ws_bill_customer_sk ws_customer_sk,
+         sum(ws_quantity) ws_qty, sum(ws_wholesale_cost) ws_wc,
+         sum(ws_sales_price) ws_sp
+  FROM web_sales
+  LEFT JOIN web_returns ON wr_order_number = ws_order_number
+                        AND ws_item_sk = wr_item_sk
+  JOIN date_dim ON ws_sold_date_sk = d_date_sk
+  WHERE wr_order_number IS NULL
+  GROUP BY d_year, ws_item_sk, ws_bill_customer_sk),
+cs AS (
+  SELECT d_year AS cs_sold_year, cs_item_sk,
+         cs_bill_customer_sk cs_customer_sk,
+         sum(cs_quantity) cs_qty, sum(cs_wholesale_cost) cs_wc,
+         sum(cs_sales_price) cs_sp
+  FROM catalog_sales
+  LEFT JOIN catalog_returns ON cr_order_number = cs_order_number
+                            AND cs_item_sk = cr_item_sk
+  JOIN date_dim ON cs_sold_date_sk = d_date_sk
+  WHERE cr_order_number IS NULL
+  GROUP BY d_year, cs_item_sk, cs_bill_customer_sk),
+ss AS (
+  SELECT d_year AS ss_sold_year, ss_item_sk,
+         ss_customer_sk,
+         sum(ss_quantity) ss_qty, sum(ss_wholesale_cost) ss_wc,
+         sum(ss_sales_price) ss_sp
+  FROM store_sales
+  LEFT JOIN store_returns ON sr_ticket_number = ss_ticket_number
+                          AND ss_item_sk = sr_item_sk
+  JOIN date_dim ON ss_sold_date_sk = d_date_sk
+  WHERE sr_ticket_number IS NULL
+  GROUP BY d_year, ss_item_sk, ss_customer_sk)
+SELECT ss_customer_sk,
+       round(ss_qty * 1.00
+             / (coalesce(ws_qty, 0) + coalesce(cs_qty, 0) + 1),
+             2) ratio,
+       ss_qty store_qty, ss_wc store_wholesale_cost,
+       ss_sp store_sales_price,
+       coalesce(ws_qty, 0) + coalesce(cs_qty, 0)
+           other_chan_qty,
+       coalesce(ws_wc, 0) + coalesce(cs_wc, 0)
+           other_chan_wholesale_cost,
+       coalesce(ws_sp, 0) + coalesce(cs_sp, 0)
+           other_chan_sales_price
+FROM ss
+LEFT JOIN ws ON (ws_sold_year = ss_sold_year
+                 AND ws_item_sk = ss_item_sk
+                 AND ws_customer_sk = ss_customer_sk)
+LEFT JOIN cs ON (cs_sold_year = ss_sold_year
+                 AND cs_item_sk = ss_item_sk
+                 AND cs_customer_sk = ss_customer_sk)
+WHERE (coalesce(ws_qty, 0) > 0 OR coalesce(cs_qty, 0) > 0)
+  AND ss_sold_year = 2000
+ORDER BY ss_customer_sk, ss_qty DESC, ss_wc DESC, ss_sp DESC,
+         other_chan_qty, other_chan_wholesale_cost,
+         other_chan_sales_price, ratio
+LIMIT 100
+""",
+    80: """
+WITH ssr AS (
+  SELECT s_store_id AS store_id,
+         sum(ss_ext_sales_price) AS sales,
+         sum(coalesce(sr_return_amt, 0)) AS returns_,
+         sum(ss_net_profit - coalesce(sr_net_loss, 0)) AS profit
+  FROM store_sales
+  LEFT OUTER JOIN store_returns
+      ON (ss_item_sk = sr_item_sk
+          AND ss_ticket_number = sr_ticket_number),
+       date_dim, store, item, promotion
+  WHERE ss_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND ss_store_sk = s_store_sk
+    AND ss_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ss_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY s_store_id),
+csr AS (
+  SELECT cp_catalog_page_id AS catalog_page_id,
+         sum(cs_ext_sales_price) AS sales,
+         sum(coalesce(cr_return_amount, 0)) AS returns_,
+         sum(cs_net_profit - coalesce(cr_net_loss, 0)) AS profit
+  FROM catalog_sales
+  LEFT OUTER JOIN catalog_returns
+      ON (cs_item_sk = cr_item_sk
+          AND cs_order_number = cr_order_number),
+       date_dim, catalog_page, item, promotion
+  WHERE cs_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND cs_catalog_page_sk = cp_catalog_page_sk
+    AND cs_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND cs_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY cp_catalog_page_id),
+wsr AS (
+  SELECT web_site_id,
+         sum(ws_ext_sales_price) AS sales,
+         sum(coalesce(wr_return_amt, 0)) AS returns_,
+         sum(ws_net_profit - coalesce(wr_net_loss, 0)) AS profit
+  FROM web_sales
+  LEFT OUTER JOIN web_returns
+      ON (ws_item_sk = wr_item_sk
+          AND ws_order_number = wr_order_number),
+       date_dim, web_site, item, promotion
+  WHERE ws_sold_date_sk = d_date_sk
+    AND d_date BETWEEN DATE '2000-08-23' AND DATE '2000-09-22'
+    AND ws_web_site_sk = web_site_sk
+    AND ws_item_sk = i_item_sk
+    AND i_current_price > 50
+    AND ws_promo_sk = p_promo_sk
+    AND p_channel_tv = 'N'
+  GROUP BY web_site_id)
+SELECT channel, id, sum(sales) sales, sum(returns_) returns_,
+       sum(profit) profit
+FROM (SELECT 'store channel' AS channel,
+             'store' || store_id AS id, sales, returns_, profit
+      FROM ssr
+      UNION ALL
+      SELECT 'catalog channel',
+             'catalog_page' || catalog_page_id,
+             sales, returns_, profit
+      FROM csr
+      UNION ALL
+      SELECT 'web channel', 'web_site' || web_site_id,
+             sales, returns_, profit
+      FROM wsr) x
+GROUP BY ROLLUP (channel, id)
+ORDER BY channel NULLS LAST, id NULLS LAST
+LIMIT 100
+""",
 }

@@ -585,6 +585,8 @@ class TpcdsConnector(Connector):
             ["Unknown"], np.zeros(n, np.int32), VarcharType(50))
         cols["s_company_id"] = Column(BIGINT, np.ones(n, np.int64),
                                       None)
+        cols["s_market_id"] = Column(
+            BIGINT, _randint(S + 12, idx, 1, 10), None)
         cols["s_street_number"] = _strings(
             [str(v) for v in range(1, 1001)],
             (_u64(S + 8, idx) % np.uint64(1000)).astype(np.int32),
@@ -1260,7 +1262,8 @@ _TABLES: Dict[str, List[CM]] = {
         _cm("s_state", _V(2)), _cm("s_city", _V(60)),
         _cm("s_number_employees", BIGINT),
         _cm("s_county", _V(30)), _cm("s_company_name", _V(50)),
-        _cm("s_company_id", BIGINT), _cm("s_street_number", _V(10)),
+        _cm("s_company_id", BIGINT), _cm("s_market_id", BIGINT),
+        _cm("s_street_number", _V(10)),
         _cm("s_street_name", _V(60)), _cm("s_street_type", _V(15)),
         _cm("s_suite_number", _V(10))],
     "promotion": [

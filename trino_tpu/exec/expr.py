@@ -784,16 +784,38 @@ def _abs(e, batch):
 def _round(e, batch):
     a = eval_expr(e.args[0], batch)
     t = a.type
+    if isinstance(t, DecimalType):
+        if a.data2 is not None:
+            raise EvalError("round(DECIMAL(p>18)) not supported yet")
+        # digits must be a constant for a static result scale
+        # (reference: round(decimal, n) with literal n — the common
+        # SQL shape; a per-row digit lane has no fixed output type)
+        if len(e.args) == 2:
+            arg1 = e.args[1]
+            if not isinstance(arg1, Const) or arg1.value is None:
+                raise EvalError(
+                    "round(decimal, n) requires a literal n")
+            n = int(arg1.value)
+        else:
+            n = 0
+        d = _lane(a).astype(jnp.int64)
+        if n >= t.scale:
+            return a
+        if t.scale - n > 18:
+            # divisor would overflow int64; every int64-lane value
+            # rounds to 0 at that magnitude (Trino returns 0 here)
+            return Column(t, jnp.zeros_like(d), a.valid)
+        div = 10 ** (t.scale - n)
+        rounded = _div_round_half_up(d, div) * div
+        return Column(t, rounded, a.valid)
+    if is_integral(t):
+        return a
     if len(e.args) == 2:
         dcol = eval_expr(e.args[1], batch)
         dd = _lane(dcol).astype(jnp.int64)
         scale = jnp.power(10.0, dd.astype(jnp.float64))
     else:
         scale = 1.0
-    if isinstance(t, DecimalType):
-        raise EvalError("round(decimal) not supported yet")
-    if is_integral(t):
-        return a
     d = _lane(a).astype(jnp.float64)
     data = jnp.sign(d) * jnp.floor(jnp.abs(d) * scale + 0.5) / scale
     return Column(t, data.astype(t.np_dtype), a.valid)
