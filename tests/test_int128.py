@@ -1,0 +1,155 @@
+"""Property tests for ops/int128.py against Python big-int arithmetic.
+
+Covers the full DECIMAL(38) magnitude range (2^63 .. 10^38) that the
+round-4 verdict flagged: single-lane int64 silently covered TPC-DS only
+because values stayed under 2^63.
+"""
+
+import random
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from trino_tpu.ops import int128 as i128
+
+M128 = 1 << 128
+
+
+def _to_signed128(q: int) -> int:
+    q &= M128 - 1
+    return q - M128 if q >= (1 << 127) else q
+
+
+def _mk(vals):
+    los, his = zip(*(i128.split_const(v) for v in vals))
+    return (jnp.asarray(np.array(los, np.int64)),
+            jnp.asarray(np.array(his, np.int64)))
+
+
+def _back(lo, hi):
+    lo = np.asarray(lo)
+    hi = np.asarray(hi)
+    return [i128.combine_host(int(l), int(h)) for l, h in zip(lo, hi)]
+
+
+def _rand_vals(rng, n, lim=10 ** 38):
+    out = []
+    for _ in range(n):
+        mag = rng.choice([10 ** 3, 2 ** 62, 2 ** 64, 10 ** 20, 10 ** 37,
+                          lim - 1])
+        out.append(rng.randint(-mag, mag))
+    out += [0, 1, -1, 2 ** 63 - 1, -(2 ** 63), 2 ** 64, -(2 ** 64),
+            10 ** 38 - 1, -(10 ** 38 - 1)]
+    return out
+
+
+@pytest.fixture(scope="module")
+def rng():
+    return random.Random(12345)
+
+
+def test_split_combine_roundtrip(rng):
+    vals = _rand_vals(rng, 50)
+    lo, hi = _mk(vals)
+    assert _back(lo, hi) == vals
+
+
+def test_add_sub_neg(rng):
+    a = _rand_vals(rng, 40)
+    b = _rand_vals(rng, 40)[:len(a)]
+    alo, ahi = _mk(a)
+    blo, bhi = _mk(b)
+    got = _back(*i128.add128(alo, ahi, blo, bhi))
+    assert got == [_to_signed128(x + y) for x, y in zip(a, b)]
+    got = _back(*i128.sub128(alo, ahi, blo, bhi))
+    assert got == [_to_signed128(x - y) for x, y in zip(a, b)]
+    got = _back(*i128.neg128(alo, ahi))
+    assert got == [_to_signed128(-x) for x in a]
+    got = _back(*i128.abs128(alo, ahi))
+    assert got == [_to_signed128(abs(x)) for x in a]
+
+
+def test_mul(rng):
+    a = _rand_vals(rng, 40, lim=10 ** 19)
+    b = _rand_vals(rng, 40, lim=10 ** 19)[:len(a)]
+    alo, ahi = _mk(a)
+    blo, bhi = _mk(b)
+    got = _back(*i128.mul128(alo, ahi, blo, bhi))
+    assert got == [_to_signed128(x * y) for x, y in zip(a, b)]
+
+
+def test_mul_const(rng):
+    a = _rand_vals(rng, 30, lim=10 ** 30)
+    alo, ahi = _mk(a)
+    for c in (1, 7, 10 ** 3, 10 ** 18, 10 ** 19):
+        got = _back(*i128.mul_const(alo, ahi, c))
+        assert got == [_to_signed128(x * c) for x in a]
+
+
+def test_cmp(rng):
+    a = _rand_vals(rng, 40)
+    b = _rand_vals(rng, 40)[:len(a)]
+    alo, ahi = _mk(a)
+    blo, bhi = _mk(b)
+    assert list(np.asarray(i128.lt128(alo, ahi, blo, bhi))) == \
+        [x < y for x, y in zip(a, b)]
+    assert list(np.asarray(i128.eq128(alo, ahi, blo, bhi))) == \
+        [x == y for x, y in zip(a, b)]
+
+
+def test_divmod_trunc(rng):
+    a = _rand_vals(rng, 25)
+    b = [v if v != 0 else 3 for v in _rand_vals(rng, 25)[:len(a)]]
+    alo, ahi = _mk(a)
+    blo, bhi = _mk(b)
+    qlo, qhi, rlo, rhi = i128.divmod128_trunc(alo, ahi, blo, bhi)
+    qs = _back(qlo, qhi)
+    rs = _back(rlo, rhi)
+    for x, y, q, r in zip(a, b, qs, rs):
+        eq = abs(x) // abs(y)
+        er = abs(x) % abs(y)
+        eq = -eq if (x < 0) != (y < 0) else eq
+        er = -er if x < 0 else er
+        assert q == eq, (x, y, q, eq)
+        assert r == er, (x, y, r, er)
+
+
+def test_div_round_half_up(rng):
+    a = _rand_vals(rng, 25)
+    alo, ahi = _mk(a)
+    for d in (2, 10, 10 ** 3, 10 ** 18, 10 ** 21):
+        got = _back(*i128.div128_round_half_up(alo, ahi, d))
+        for x, g in zip(a, got):
+            # HALF_UP away from zero, in exact integer arithmetic
+            # (Decimal's default 28-digit context would round the oracle)
+            exp = (abs(x) + d // 2) // d
+            exp = -exp if x < 0 else exp
+            assert g == exp, (x, d, g, exp)
+
+
+def test_rescale_roundtrip():
+    vals = [123456789012345678901234567, -9 * 10 ** 30, 5, -5, 0]
+    lo, hi = _mk(vals)
+    up = i128.rescale(lo, hi, 6)
+    assert _back(*up) == [v * 10 ** 6 for v in vals]
+    down = i128.rescale(*up, -6)
+    assert _back(*down) == vals
+
+
+def test_sum_lanes(rng):
+    vals = _rand_vals(rng, 200, lim=10 ** 36)
+    lo, hi = _mk(vals)
+    s0, s1, s2 = i128.sum_lanes(lo, hi)
+    tot = i128.combine_sums(jnp.sum(s0)[None], jnp.sum(s1)[None],
+                            jnp.sum(s2)[None])
+    assert _back(*tot)[0] == _to_signed128(sum(vals))
+
+
+def test_to_from_double():
+    vals = [0, 5, -5, 2 ** 70, -(2 ** 70)]
+    lo, hi = _mk(vals)
+    d = np.asarray(i128.to_double(lo, hi))
+    assert list(d) == [float(v) for v in vals]
+    lo2, hi2 = i128.from_double(jnp.asarray(d))
+    assert _back(lo2, hi2) == vals

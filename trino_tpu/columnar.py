@@ -240,7 +240,10 @@ def _to_lane(values, typ: Type):
                 # reference: spi/type/Decimals.java) — going through
                 # binary float multiply would be off-by-one near .5
                 import decimal
-                q = int(decimal.Decimal(str(v)).scaleb(typ.scale)
+                # prec=80: the default 28-digit context silently rounds
+                # DECIMAL(38) magnitudes during scaleb/multiply
+                ctx = decimal.Context(prec=80)
+                q = int(decimal.Decimal(str(v)).scaleb(typ.scale, ctx)
                         .to_integral_value(rounding=decimal.ROUND_HALF_UP))
             if long_decimal:
                 # two's-complement split: lo = unsigned low 64 bits
@@ -418,7 +421,8 @@ class Batch:
                         # layer formats; reference: client decimals are
                         # exact strings, FixJsonDataUtils.java)
                         col.append(q if not s
-                                   else _dec.Decimal(q).scaleb(-s))
+                                   else _dec.Decimal(q).scaleb(
+                                       -s, _dec.Context(prec=80)))
             elif t.name == "hyperloglog":
                 # rendered like the client renders varbinary: base64 of
                 # this engine's dense sketch framing (ops/hll.py)

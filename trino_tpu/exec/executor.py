@@ -1646,11 +1646,28 @@ def _avg_post(ssym, csym, rtype):
         s = out.column(ssym)
         c = out.column(csym)
         cnt = jnp.asarray(c.data).astype(jnp.float64)
+        valid = cnt > 0
+        if isinstance(rtype, DecimalType) and s.data2 is not None:
+            # Int128 sum: exact division by the count (HALF_UP), then
+            # rescale sum-scale -> result-scale.
+            # Reference: DecimalAverageAggregation.java
+            from ..ops import int128 as i128
+            lo = jnp.asarray(s.data).astype(jnp.int64)
+            hi = jnp.asarray(s.data2).astype(jnp.int64)
+            shift = rtype.scale - s.type.scale
+            lo, hi = i128.rescale(lo, hi, max(shift, 0))
+            cn = jnp.maximum(jnp.asarray(c.data).astype(jnp.int64), 1)
+            lo, hi = i128.div128_round_half_up_pair(
+                lo, hi, cn, jnp.zeros_like(cn))
+            if shift < 0:
+                lo, hi = i128.rescale(lo, hi, shift)
+            if rtype.is_short:
+                return Column(rtype, lo, valid)
+            return Column(rtype, lo, valid, data2=hi)
         num = jnp.asarray(s.data).astype(jnp.float64)
         if isinstance(s.type, DecimalType):
             num = num / (10.0 ** s.type.scale)
         data = num / jnp.maximum(cnt, 1.0)
-        valid = cnt > 0
         if isinstance(rtype, DecimalType):
             q = (jnp.sign(data) *
                  jnp.floor(jnp.abs(data) * 10.0 ** rtype.scale + 0.5))

@@ -98,9 +98,12 @@ def _const_column(e: Const, cap: int) -> Column:
             q = v * 10 ** t.scale
         elif isinstance(v, str):
             # exact: a float round-trip would corrupt literals beyond
-            # 2^53 (q34-style wide-decimal comparisons)
-            from decimal import Decimal as _D
-            q = int((_D(v) * (10 ** t.scale)).to_integral_value())
+            # 2^53 (q34-style wide-decimal comparisons); prec=80 because
+            # the default 28-digit context rounds DECIMAL(38) magnitudes
+            from decimal import (Context as _DC, Decimal as _D,
+                                 ROUND_HALF_UP as _RHU)
+            q = int(_D(v).scaleb(t.scale, _DC(prec=80))
+                    .to_integral_value(rounding=_RHU))
         else:
             q = int(round(float(v) * (10 ** t.scale)))
         if not t.is_short:
@@ -156,6 +159,43 @@ def _dict_transform(col: Column, fn: Callable[[str], object],
         nv = ~jnp.take(jnp.asarray(nulls), _lane(col), mode="clip")
         valid = nv if valid is None else (jnp.asarray(valid) & nv)
     return Column(out_type, data, valid)
+
+
+def _parse_long_decimal_dict(col: Column, t, safe: bool) -> Column:
+    """varchar -> DECIMAL(p>18): parse the dictionary host-side into
+    128-bit quantized values, emit (lo, hi) gather tables. The single
+    -lane _dict_transform overflows int64 here (round-4 verdict repro).
+    Reference: spi/type/Decimals.java parse + Int128 representation."""
+    from decimal import (Context as _DC, Decimal as _D, InvalidOperation,
+                         ROUND_HALF_UP as _RHU)
+    from ..ops.int128 import split_const
+    ctx = _DC(prec=80)
+    los, his, nulls = [], [], []
+    for v in col.dictionary.values:
+        try:
+            q = int(_D(str(v).strip()).scaleb(t.scale, ctx)
+                    .to_integral_value(rounding=_RHU))
+            lo, hi = split_const(q)
+            los.append(lo)
+            his.append(hi)
+            nulls.append(False)
+        except (InvalidOperation, ValueError, OverflowError):
+            if not safe:
+                raise EvalError(f"Cannot cast '{v}' to {t}") from None
+            los.append(0)
+            his.append(0)
+            nulls.append(True)
+    codes = _lane(col)
+    lo = jnp.take(jnp.asarray(np.asarray(los, np.int64)), codes,
+                  mode="clip")
+    hi = jnp.take(jnp.asarray(np.asarray(his, np.int64)), codes,
+                  mode="clip")
+    valid = col.valid
+    nulls = np.asarray(nulls, dtype=bool)
+    if nulls.any():
+        nv = ~jnp.take(jnp.asarray(nulls), codes, mode="clip")
+        valid = nv if valid is None else (jnp.asarray(valid) & nv)
+    return Column(t, lo, valid, data2=hi)
 
 
 def _materialize_strings(col: Column, n: Optional[int] = None) -> List:
@@ -328,6 +368,8 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
                       lns, Column(_INT, jnp.asarray(flat)))
     # string source -> parse host-side over dictionary
     if is_string(s) and not is_string(t):
+        if isinstance(t, DecimalType) and not t.is_short:
+            return _parse_long_decimal_dict(src, t, safe)
         return _dict_transform(src, _parser_for(t, safe), t)
     if is_string(t):
         if is_string(s):
@@ -350,21 +392,30 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
             return Column(t, sv.astype(jnp.float32), src.valid)
         if is_integral(t):
             if src.data2 is not None:
-                raise EvalError(
-                    "DECIMAL(p>18) to integer cast not supported yet")
+                from ..ops import int128 as i128
+                lo, _hi = i128.rescale(d.astype(jnp.int64),
+                                       jnp.asarray(src.data2)
+                                       .astype(jnp.int64), -s.scale)
+                return Column(t, lo.astype(t.np_dtype), src.valid)
             return Column(t, _round_half_up(sv).astype(t.np_dtype),
                           src.valid)
         if isinstance(t, DecimalType):
             shift = t.scale - s.scale
-            if shift == 0:
+            if shift == 0 and t.is_short == s.is_short:
                 # precision-only change: keep both Int128 lanes intact
                 return dc_replace(src, type=t)
-            if src.data2 is not None:
-                # rescaling a live Int128 value needs 128-bit
-                # multiply/divide; silently dropping the hi lane would
-                # return wrong rows — fail loudly instead
-                raise EvalError(
-                    "DECIMAL(p>18) rescale not supported yet")
+            if src.data2 is not None or not t.is_short:
+                from ..ops import int128 as i128
+                lo = d.astype(jnp.int64)
+                hi = (jnp.asarray(src.data2).astype(jnp.int64)
+                      if src.data2 is not None else i128.sign_extend(lo))
+                lo, hi = i128.rescale(lo, hi, shift)
+                if t.is_short:
+                    # in-range values fit the low lane exactly; the
+                    # reference raises on overflow, we wrap (documented
+                    # in ops/int128.py)
+                    return Column(t, lo, src.valid)
+                return Column(t, lo, src.valid, data2=hi)
             if shift >= 0:
                 nd = d * (10 ** shift)
             else:
@@ -374,10 +425,21 @@ def cast_column(src: Column, t: Type, safe: bool = False) -> Column:
             return Column(t, d != 0, src.valid)
     if isinstance(t, DecimalType):
         if is_integral(s) or s is BOOLEAN:
+            if not t.is_short:
+                from ..ops import int128 as i128
+                lo = d.astype(jnp.int64)
+                lo, hi = i128.rescale(lo, i128.sign_extend(lo), t.scale)
+                return Column(t, lo, src.valid, data2=hi)
             return Column(t, d.astype(jnp.int64) * (10 ** t.scale),
                           src.valid)
         # float -> decimal, HALF_UP
         scaled = d.astype(jnp.float64) * (10.0 ** t.scale)
+        if not t.is_short:
+            from ..ops import int128 as i128
+            rounded = (jnp.sign(scaled)
+                       * jnp.floor(jnp.abs(scaled) + 0.5))
+            lo, hi = i128.from_double(rounded)
+            return Column(t, lo, src.valid, data2=hi)
         return Column(t, _round_half_up(scaled), src.valid)
     if t.name in ("double", "real"):
         return Column(t, d.astype(t.np_dtype), src.valid)
@@ -472,6 +534,9 @@ def _to_varchar(src: Column, t: Type) -> Column:
     n = src.capacity
     data = np.asarray(src.data)
     valid = None if src.valid is None else np.asarray(src.valid)
+    hi_arr = (np.asarray(src.data2)
+              if src.data2 is not None and isinstance(s, DecimalType)
+              else None)
     out = []
     for i in range(n):
         if valid is not None and not valid[i]:
@@ -484,6 +549,8 @@ def _to_varchar(src: Column, t: Type) -> Column:
                 int(v) + datetime.date(1970, 1, 1).toordinal())))
         elif isinstance(s, DecimalType):
             q = int(v)
+            if hi_arr is not None:
+                q = (int(hi_arr[i]) << 64) + (q & ((1 << 64) - 1))
             if s.scale:
                 sign = "-" if q < 0 else ""
                 q = abs(q)
@@ -722,15 +789,7 @@ def _decimal_arith(op: str):
         b = eval_expr(e.args[1], batch)
         t: DecimalType = e.type
         if (a.data2 is not None) or (b.data2 is not None) or not t.is_short:
-            # fall back through double for long decimals (documented
-            # precision loss; exact Int128 kernels in ops/int128 TBD)
-            da = cast_column(a, DOUBLE)
-            db = cast_column(b, DOUBLE)
-            call = Call(op, (InputRef("_a", DOUBLE), InputRef("_b", DOUBLE)),
-                        DOUBLE)
-            tmp = Batch({"_a": da, "_b": db}, batch.num_rows)
-            res = _arith(op)(call, tmp)
-            return cast_column(res, t)
+            return _decimal_arith_128(op, a, b, t)
         sa = a.type.scale if isinstance(a.type, DecimalType) else 0
         sb = b.type.scale if isinstance(b.type, DecimalType) else 0
         da = _lane(a).astype(jnp.int64)
@@ -761,8 +820,62 @@ def _decimal_arith(op: str):
     return h
 
 
+def _decimal_arith_128(op: str, a: Column, b: Column,
+                       t: "DecimalType") -> Column:
+    """Exact Int128 decimal arithmetic over (lo, hi) lanes.
+    Reference: spi/type/UnscaledDecimal128Arithmetic.java:42 (add /
+    multiply / rescale on Int128, HALF_UP rounding)."""
+    from ..ops import int128 as i128
+    sa = a.type.scale if isinstance(a.type, DecimalType) else 0
+    sb = b.type.scale if isinstance(b.type, DecimalType) else 0
+    valid = _merge_valid(a, b)
+
+    def lanes(c):
+        lo = _lane(c).astype(jnp.int64)
+        hi = (jnp.asarray(c.data2).astype(jnp.int64)
+              if c.data2 is not None else i128.sign_extend(lo))
+        return lo, hi
+
+    alo, ahi = lanes(a)
+    blo, bhi = lanes(b)
+    if op in ("+", "-"):
+        alo, ahi = i128.rescale(alo, ahi, t.scale - sa)
+        blo, bhi = i128.rescale(blo, bhi, t.scale - sb)
+        lo, hi = (i128.add128(alo, ahi, blo, bhi) if op == "+"
+                  else i128.sub128(alo, ahi, blo, bhi))
+    elif op == "*":
+        lo, hi = i128.mul128(alo, ahi, blo, bhi)
+        lo, hi = i128.rescale(lo, hi, t.scale - sa - sb)
+    elif op == "/":
+        # (a/b) at scale t.scale: round(a * 10^(t.scale - sa + sb) / b)
+        shift = t.scale - sa + sb
+        alo, ahi = i128.rescale(alo, ahi, max(shift, 0))
+        blo, bhi = i128.rescale(blo, bhi, max(-shift, 0))
+        zero = (blo == 0) & (bhi == 0)
+        blo_s = jnp.where(zero, 1, blo)
+        lo, hi = i128.div128_round_half_up_pair(alo, ahi, blo_s, bhi)
+        valid = (~zero if valid is None else valid & ~zero)
+    else:  # %
+        # operands must agree on the result scale before the divmod
+        # (150@s2 mod 30@s1 is 0.20, not the dimensionally-true 2.00)
+        alo, ahi = i128.rescale(alo, ahi, t.scale - sa)
+        blo, bhi = i128.rescale(blo, bhi, t.scale - sb)
+        zero = (blo == 0) & (bhi == 0)
+        blo_s = jnp.where(zero, 1, blo)
+        _, _, lo, hi = i128.divmod128_trunc(alo, ahi, blo_s, bhi)
+        valid = (~zero if valid is None else valid & ~zero)
+    if t.is_short:
+        return Column(t, lo, valid)
+    return Column(t, lo, valid, data2=hi)
+
+
 def _negate(e, batch):
     a = eval_expr(e.args[0], batch)
+    if a.data2 is not None and isinstance(a.type, DecimalType):
+        from ..ops import int128 as i128
+        lo, hi = i128.neg128(_lane(a).astype(jnp.int64),
+                             jnp.asarray(a.data2).astype(jnp.int64))
+        return dc_replace(a, data=lo, data2=hi, type=e.type)
     return dc_replace(a, data=-_lane(a), type=e.type)
 
 
@@ -778,6 +891,11 @@ def _unary_np(fn):
 
 def _abs(e, batch):
     a = eval_expr(e.args[0], batch)
+    if a.data2 is not None and isinstance(a.type, DecimalType):
+        from ..ops import int128 as i128
+        lo, hi = i128.abs128(_lane(a).astype(jnp.int64),
+                             jnp.asarray(a.data2).astype(jnp.int64))
+        return dc_replace(a, data=lo, data2=hi)
     return dc_replace(a, data=jnp.abs(_lane(a)))
 
 
@@ -786,7 +904,26 @@ def _round(e, batch):
     t = a.type
     if isinstance(t, DecimalType):
         if a.data2 is not None:
-            raise EvalError("round(DECIMAL(p>18)) not supported yet")
+            if len(e.args) == 2:
+                arg1 = e.args[1]
+                if not isinstance(arg1, Const) or arg1.value is None:
+                    raise EvalError(
+                        "round(decimal, n) requires a literal n")
+                n = int(arg1.value)
+            else:
+                n = 0
+            if n >= t.scale:
+                return a
+            if t.scale - n > 38:
+                # 10^(scale-n) exceeds 128 bits: every value rounds to 0
+                z = jnp.zeros_like(_lane(a).astype(jnp.int64))
+                return Column(t, z, a.valid, data2=z)
+            from ..ops import int128 as i128
+            lo = _lane(a).astype(jnp.int64)
+            hi = jnp.asarray(a.data2).astype(jnp.int64)
+            lo, hi = i128.rescale(lo, hi, -(t.scale - n))
+            lo, hi = i128.rescale(lo, hi, t.scale - n)
+            return Column(t, lo, a.valid, data2=hi)
         # digits must be a constant for a static result scale
         # (reference: round(decimal, n) with literal n — the common
         # SQL shape; a per-row digit lane has no fixed output type)

@@ -317,9 +317,6 @@ def _masked_agg(batch: Batch, agg: AggInput, gmasks, live,
         return Column(BIGINT, data, None)
 
     col = batch.column(agg.input)
-    if col.data2 is not None and agg.kind in ("sum", "min", "max"):
-        raise NotImplementedError(
-            f"{agg.kind} over DECIMAL(p>18) is not supported yet")
     vals = jnp.asarray(col.data)
     if col.valid is not None:
         v = jnp.asarray(col.valid)
@@ -331,6 +328,9 @@ def _masked_agg(batch: Batch, agg: AggInput, gmasks, live,
 
     nvalid = jnp.stack([jnp.sum(g.astype(jnp.int64)) for g in gmasks])
     group_valid = nvalid > 0
+
+    if _wide_decimal_agg(col, agg.kind):
+        return _int128_masked_agg(col, agg.kind, gmasks, group_valid)
 
     if agg.kind == "sum":
         acc_dtype = vals.dtype if vals.dtype in (
@@ -442,6 +442,93 @@ def group_aggregate(batch: Batch, key_names: Sequence[str],
     return Batch(out_cols, num_groups)
 
 
+def _int128_lanes(col: Column, order=None):
+    lo = jnp.asarray(col.data).astype(jnp.int64)
+    # a short-decimal input sign-extends into the hi lane (its sum can
+    # still overflow int64 — that's why the SQL sum type is DECIMAL(38))
+    hi = (jnp.asarray(col.data2).astype(jnp.int64)
+          if col.data2 is not None else lo >> 63)
+    if order is not None:
+        lo = jnp.take(lo, order)
+        hi = jnp.take(hi, order)
+    return lo, hi
+
+
+def _wide_decimal_agg(col: Column, kind: str) -> bool:
+    """True when the aggregate must run on Int128 lanes: any long
+    decimal, and a short-decimal SUM that could overflow int64
+    (reference: DecimalSumAggregation accumulates in Int128). Column
+    capacity is static, so capacity * 10^precision < 2^63 proves the
+    single-lane int64 sum exact — keeps the hot TPC-H money sums
+    (DECIMAL(12,2) at sf1) on the 1-lane kernel."""
+    from ..types import DecimalType as _Dec
+    if not isinstance(col.type, _Dec):
+        return False
+    if col.data2 is not None:
+        return kind in ("sum", "min", "max")
+    if kind != "sum":
+        return False
+    cap = int(jnp.asarray(col.data).shape[0])
+    return cap * (10 ** col.type.precision) >= 2 ** 63
+
+
+def _int128_masked_agg(col: Column, kind: str, gmasks, group_valid,
+                       order=None) -> Column:
+    """sum/min/max over DECIMAL(p>18) for the mask-per-group kernels.
+
+    sum: each value decomposes into three int64 addend lanes
+    (w0 + w1*2^32 + hi*2^64, 0 <= w0,w1 < 2^32) so per-group sums of up
+    to 2^31 rows stay exact; lanes recombine with carry propagation.
+    min/max: composite order (hi major signed, lo minor unsigned via
+    the sign-flip trick). Reference: Int128 state of
+    spi/type/Int128Math.java + DecimalSumAggregation."""
+    from . import int128 as i128
+    lo, hi = _int128_lanes(col, order)
+    if kind == "sum":
+        w0, w1, w2 = i128.sum_lanes(lo, hi)
+        z = jnp.int64(0)
+        s0 = jnp.stack([jnp.sum(jnp.where(g, w0, z)) for g in gmasks])
+        s1 = jnp.stack([jnp.sum(jnp.where(g, w1, z)) for g in gmasks])
+        s2 = jnp.stack([jnp.sum(jnp.where(g, w2, z)) for g in gmasks])
+        slo, shi = i128.combine_sums(s0, s1, s2)
+        return Column(_sum_type(col.type), slo, group_valid, data2=shi)
+    red = jnp.min if kind == "min" else jnp.max
+    ident = _identity_for(kind, jnp.int64)
+    mhi = jnp.stack([red(jnp.where(g, hi, ident)) for g in gmasks])
+    sbit = jnp.int64(-(2 ** 63))
+    ulo = lo ^ sbit
+    mlo = jnp.stack([red(jnp.where(g & (hi == mhi[k]), ulo, ident))
+                     for k, g in enumerate(gmasks)]) ^ sbit
+    return Column(col.type, mlo, group_valid, data2=mhi)
+
+
+def _int128_segment_agg(col: Column, kind: str, valid, order, gid,
+                        gcap: int, group_valid) -> Column:
+    """sum/min/max over DECIMAL(p>18) for the lexsort/segment kernel
+    (same lane decomposition as _int128_masked_agg)."""
+    from . import int128 as i128
+    lo, hi = _int128_lanes(col, order)
+    if kind == "sum":
+        w0, w1, w2 = i128.sum_lanes(lo, hi)
+        z = jnp.int64(0)
+        s0 = jax.ops.segment_sum(jnp.where(valid, w0, z), gid,
+                                 num_segments=gcap)
+        s1 = jax.ops.segment_sum(jnp.where(valid, w1, z), gid,
+                                 num_segments=gcap)
+        s2 = jax.ops.segment_sum(jnp.where(valid, w2, z), gid,
+                                 num_segments=gcap)
+        slo, shi = i128.combine_sums(s0, s1, s2)
+        return Column(_sum_type(col.type), slo, group_valid, data2=shi)
+    seg = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
+    ident = _identity_for(kind, jnp.int64)
+    mhi = seg(jnp.where(valid, hi, ident), gid, num_segments=gcap)
+    sbit = jnp.int64(-(2 ** 63))
+    ulo = lo ^ sbit
+    elig = valid & (hi == jnp.take(mhi, gid))
+    mlo = seg(jnp.where(elig, ulo, ident), gid, num_segments=gcap) ^ sbit
+    return Column(col.type, mlo, group_valid, data2=mhi)
+
+
 def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
                  gcap: int, key_lanes=None, live_u=None) -> Column:
     from ..types import BIGINT, DOUBLE, is_string
@@ -462,12 +549,6 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
         return Column(BIGINT, data, None)
 
     col = batch.column(agg.input)
-    if col.data2 is not None and agg.kind in ("sum", "min", "max"):
-        # Int128 lane arithmetic (carry-propagating segment sums) is not
-        # implemented yet — fail loudly rather than reduce the lo lane
-        # (SURVEY.md §7 hard part 4)
-        raise NotImplementedError(
-            f"{agg.kind} over DECIMAL(p>18) is not supported yet")
     vals = jnp.take(jnp.asarray(col.data), order)
     valid = live_s if col.valid is None else (
         live_s & jnp.take(jnp.asarray(col.valid), order))
@@ -482,6 +563,10 @@ def _segment_agg(batch: Batch, agg: AggInput, order, gid, live_s,
     nvalid = jax.ops.segment_sum(valid.astype(jnp.int64), gid,
                                  num_segments=gcap)
     group_valid = nvalid > 0
+
+    if _wide_decimal_agg(col, agg.kind):
+        return _int128_segment_agg(col, agg.kind, valid, order, gid,
+                                   gcap, group_valid)
 
     if agg.kind == "sum":
         acc_dtype = vals.dtype if vals.dtype in (
@@ -902,9 +987,6 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
                 BIGINT, jnp.sum(m.astype(jnp.int64))[None], None)
             continue
         col = batch.column(agg.input)
-        if col.data2 is not None and agg.kind in ("sum", "min", "max"):
-            raise NotImplementedError(
-                f"{agg.kind} over DECIMAL(p>18) is not supported yet")
         vals = jnp.asarray(col.data)
         valid = live if col.valid is None else live & jnp.asarray(col.valid)
         if extra is not None:
@@ -914,6 +996,10 @@ def global_aggregate(batch: Batch, aggs: Sequence[AggInput],
             out[agg.output] = Column(BIGINT, n[None], None)
             continue
         has = (n > 0)[None]
+        if _wide_decimal_agg(col, agg.kind):
+            out[agg.output] = _int128_masked_agg(col, agg.kind, [valid],
+                                                 has)
+            continue
         if agg.kind == "sum":
             acc_dtype = vals.dtype if vals.dtype in (
                 jnp.float32, jnp.float64) else jnp.int64
