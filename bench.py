@@ -83,7 +83,9 @@ def _q1_columns_cached(sf: float):
     cols = _gen_q1_columns(sf)
     try:
         os.makedirs(CACHE_DIR, exist_ok=True)
-        tmp = path + f".tmp.{os.getpid()}"
+        # np.savez appends .npz when missing — name the temp file with
+        # the suffix or os.replace never finds it
+        tmp = path + f".{os.getpid()}.tmp.npz"
         np.savez(tmp, **{f"c{i}": c for i, c in enumerate(cols)})
         os.replace(tmp, path)
     except Exception:

@@ -1,0 +1,164 @@
+"""Coordinator -> remote worker dispatch for REAL queries.
+
+Reference parity: the DistributedQueryRunner tier —
+server/remotetask/HttpRemoteTask.java:103 (fragment POST),
+execution/SqlTaskManager.java:370-403 (worker execution),
+operator/ExchangeClient.java:149 (page pulls). A coordinator process
+plans the query, ships serialized leaf fragments (plan/serde.py) to two
+worker PROCESSES with (part, nparts) split shares, pulls pages, and
+combines locally; results must equal LocalQueryRunner exactly.
+"""
+
+import multiprocessing as mp
+
+import pytest
+
+from trino_tpu.exec.remote import DistributedHostQueryRunner, RemoteScheduler
+from trino_tpu.plan.serde import from_jsonable, to_jsonable
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.server.task_worker import spawn_worker_env, worker_main
+from trino_tpu.session import Session
+
+
+@pytest.fixture(scope="module")
+def workers():
+    ctx = mp.get_context("spawn")
+    procs = []
+    uris = []
+    with spawn_worker_env():
+        for _ in range(2):
+            parent, child = ctx.Pipe()
+            p = ctx.Process(target=worker_main, args=(child, "cpu"),
+                            daemon=True)
+            p.start()
+            if not parent.poll(180):
+                raise RuntimeError("worker child did not start")
+            uris.append(f"http://127.0.0.1:{parent.recv()}")
+            procs.append(p)
+    yield uris
+    for p in procs:
+        p.terminate()
+
+
+def _check(workers, sql, approx_cols=()):
+    dist = DistributedHostQueryRunner(
+        workers, session=Session(catalog="tpch", schema="tiny"))
+    local = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"))
+    got = dist.execute(sql)
+    exp = local.execute(sql)
+    assert got.columns == exp.columns
+    assert len(got.rows) == len(exp.rows)
+    for g, e in zip(got.rows, exp.rows):
+        for i, (gv, ev) in enumerate(zip(g, e)):
+            if i in approx_cols:
+                assert gv == pytest.approx(ev, rel=1e-9)
+            else:
+                assert gv == ev
+
+
+def test_plan_serde_roundtrips_tpch_plans():
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.planner.logical import LogicalPlanner
+    from trino_tpu.planner.optimizer import optimize
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    from trino_tpu.sql.parser import parse_statement
+    for qn in (1, 3, 6, 18):
+        stmt = parse_statement(TPCH_QUERIES[qn])
+        plan = optimize(LogicalPlanner(r.catalogs, r.session).plan(stmt),
+                        r.catalogs, r.session)
+        assert from_jsonable(to_jsonable(plan)) == plan
+
+
+def test_fragmenter_cuts_scan_chains():
+    """Plan shape check without processes: q3 produces one fragment per
+    base table; q1 pushes a partial aggregation into its fragment."""
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.plan.nodes import AggregationNode
+    from trino_tpu.planner.logical import LogicalPlanner
+    from trino_tpu.planner.optimizer import optimize
+    from trino_tpu.sql.parser import parse_statement
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    sched = RemoteScheduler.__new__(RemoteScheduler)  # no workers needed
+    sched.catalogs, sched.session = r.catalogs, r.session
+
+    stmt = parse_statement(TPCH_QUERIES[3])
+    plan = optimize(LogicalPlanner(r.catalogs, r.session).plan(stmt),
+                    r.catalogs, r.session)
+    frags = []
+    sched._cut(plan, frags)
+    assert len(frags) == 3      # customer, orders, lineitem chains
+
+    stmt = parse_statement(TPCH_QUERIES[1])
+    plan = optimize(LogicalPlanner(r.catalogs, r.session).plan(stmt),
+                    r.catalogs, r.session)
+    frags = []
+    sched._cut(plan, frags)
+    assert len(frags) == 1
+    assert isinstance(frags[0].plan, AggregationNode)  # partial pushed
+
+
+def test_remote_q6(workers):
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    _check(workers, TPCH_QUERIES[6], approx_cols=(0,))
+
+
+def test_remote_q1(workers):
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    _check(workers, TPCH_QUERIES[1], approx_cols=(2, 3, 4, 5, 6, 7, 8))
+
+
+def test_remote_q3(workers):
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    _check(workers, TPCH_QUERIES[3], approx_cols=(1,))
+
+
+def test_remote_decimal_and_strings(workers):
+    _check(workers,
+           "SELECT n_name, count(*) FROM nation "
+           "JOIN region ON n_regionkey = r_regionkey "
+           "WHERE r_name = 'ASIA' GROUP BY n_name ORDER BY n_name")
+
+
+def test_remote_topn_pushdown(workers):
+    _check(workers,
+           "SELECT o_orderkey, o_totalprice FROM orders "
+           "ORDER BY o_totalprice DESC LIMIT 10", approx_cols=(1,))
+
+
+def test_remote_decimal_aggregates_exact(workers):
+    """Decimal sum/avg through remote partial/final must be bit-exact
+    vs local (no approx): the avg reconstruction divides the Int128 sum
+    with the decimal kernel, not float."""
+    dist = DistributedHostQueryRunner(
+        workers, session=Session(catalog="tpcds", schema="tiny"))
+    local = LocalQueryRunner(
+        session=Session(catalog="tpcds", schema="tiny"))
+    sql = ("SELECT ss_store_sk, sum(ss_ext_sales_price), "
+           "avg(ss_sales_price), min(ss_net_paid), max(ss_net_paid) "
+           "FROM store_sales GROUP BY ss_store_sk ORDER BY ss_store_sk")
+    got = dist.execute(sql)
+    exp = local.execute(sql)
+    assert got.rows == exp.rows     # exact, including NULL groups
+
+
+def test_http_coordinator_dispatches_to_workers(workers):
+    """The FULL reference shape: client -> coordinator HTTP -> worker
+    HTTP -> pages back -> client rows (server/coordinator.py routing
+    through exec/remote.py when a worker fleet is registered)."""
+    from trino_tpu.client import StatementClient
+    from trino_tpu.server.coordinator import Coordinator
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    coord = Coordinator(worker_uris=list(workers)).start()
+    try:
+        c = StatementClient(coord.base_uri, catalog="tpch",
+                            schema="tiny")
+        got = c.execute(TPCH_QUERIES[3])
+        exp = LocalQueryRunner(
+            session=Session(catalog="tpch", schema="tiny")).execute(
+                TPCH_QUERIES[3])
+        assert [r[0] for r in got.rows] == [r[0] for r in exp.rows]
+        nodes = c.execute("SELECT count(*) FROM system.runtime.nodes")
+        assert nodes.rows[0][0] == 3      # coordinator + 2 workers
+    finally:
+        coord.stop()

@@ -600,7 +600,9 @@ def _pad_one(b: Batch) -> Batch:
         data = jnp.pad(jnp.asarray(c.data), (0, 8 - c.capacity))
         valid = (None if c.valid is None
                  else jnp.pad(jnp.asarray(c.valid), (0, 8 - c.capacity)))
-        cols[s] = Column(c.type, data, valid, c.dictionary)
+        d2 = (None if c.data2 is None
+              else jnp.pad(jnp.asarray(c.data2), (0, 8 - c.capacity)))
+        cols[s] = Column(c.type, data, valid, c.dictionary, data2=d2)
     return Batch(cols, b.num_rows)
 
 
@@ -678,7 +680,21 @@ def _trace_concat(a: Batch, b: Batch, out_cap: int) -> Batch:
             vb = (jnp.ones((cb.capacity,), bool) if cb.valid is None
                   else jnp.asarray(cb.valid))
             valid = jnp.take(jnp.concatenate([va, vb]), idx, mode="clip")
-        cols[name] = Column(ca.type, data, valid, ca.dictionary)
+        d2 = None
+        if ca.data2 is not None or cb.data2 is not None:
+            from ..types import DecimalType as _Dec
+            dec_hi = isinstance(ca.type, _Dec)
+
+            def _hi(c):
+                if c.data2 is not None:
+                    return jnp.asarray(c.data2)
+                if dec_hi:   # sign-extend a missing Int128 hi lane
+                    return jnp.asarray(c.data).astype(jnp.int64) >> 63
+                return jnp.zeros((c.capacity,), jnp.int64)
+            d2 = jnp.take(jnp.concatenate([_hi(ca), _hi(cb)]), idx,
+                          mode="clip")
+        cols[name] = Column(ca.type, data, valid, ca.dictionary,
+                            data2=d2)
     return Batch(cols, na + nb)
 
 

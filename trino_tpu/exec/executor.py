@@ -189,6 +189,11 @@ class Executor:
         self.fragment_jit = fragment_jit
         self._no_jit_chains: set = set()
         self._jit_chains: dict = {}
+        # remote-task split addressing: (part, nparts) makes every scan
+        # read only splits with index % nparts == part (the worker's
+        # share of a fragment — server/task_worker.py fragment payloads;
+        # reference: SqlStageExecution assigning splits to tasks)
+        self.scan_partition: Optional[Tuple[int, int]] = None
 
     def _detached(self) -> "Executor":
         """Lightweight clone captured by closures that outlive this
@@ -312,13 +317,20 @@ class Executor:
         # resident, the filter->project->aggregate chain runs as ONE
         # device program over all rows — the hand-fused micro's shape —
         # instead of one dispatch per split through the tunnel
-        whole = read_table_cached(conn, cur.handle, columns, par)
+        whole = (None if self.scan_partition is not None
+                 else read_table_cached(conn, cur.handle, columns, par))
         raws: Optional[List[Batch]] = None
         if whole is not None:
             raws = [whole]
         else:
             splits = conn.get_splits(cur.handle, par)
-            if len(splits) < 2:
+            if self.scan_partition is not None:
+                part, nparts = self.scan_partition
+                splits = [s for i, s in enumerate(splits)
+                          if i % nparts == part]
+                if not splits:
+                    return None    # generic path emits the empty batch
+            if len(splits) < 2 and self.scan_partition is None:
                 return None
         partials: List[Batch] = []
         phys = post = None
@@ -570,6 +582,22 @@ class Executor:
         conn = self.catalogs.connector(node.handle.catalog)
         columns = sorted(set(node.assignments.values()))
         par = int(self.session.get("task_concurrency")) or 1
+        if self.scan_partition is not None:
+            part, nparts = self.scan_partition
+            splits = conn.get_splits(node.handle, par)
+            mine = [s for i, s in enumerate(splits)
+                    if i % nparts == part]
+            if not mine:
+                from ..columnar import batch_from_pylist
+                return batch_from_pylist(
+                    {s: [] for s in node.schema}, dict(node.schema))
+            batches = [read_split_cached(conn, s, columns)
+                       for s in mine]
+            whole = (device_concat(batches) if len(batches) > 1
+                     else batches[0])
+            cols = {sym: whole.column(col)
+                    for sym, col in node.assignments.items()}
+            return Batch(cols, whole.num_rows)
         whole = read_table_cached(conn, node.handle, columns, par)
         if whole is None:
             splits = conn.get_splits(node.handle, par)
@@ -1824,10 +1852,19 @@ def device_concat(parts: Sequence[Batch]) -> Batch:
                              mode="clip")
         d2 = None
         if any(c.data2 is not None for c in cols):
-            l2 = [jnp.zeros((c.capacity,), jnp.int64) if c.data2 is None
-                  else jnp.asarray(c.data2) for c in cols]
-            d2 = jnp.take(jnp.concatenate(l2), jnp.asarray(idx),
-                          mode="clip")
+            # a missing hi lane means sign-extension for Int128 decimal
+            # columns (a negative lo zero-filled would be off by 2^64);
+            # timestamptz offsets fill with zeros (UTC)
+            dec_hi = isinstance(typ, DecimalType)
+
+            def _fill(c):
+                if c.data2 is not None:
+                    return jnp.asarray(c.data2)
+                if dec_hi:
+                    return jnp.asarray(c.data).astype(jnp.int64) >> 63
+                return jnp.zeros((c.capacity,), jnp.int64)
+            d2 = jnp.take(jnp.concatenate([_fill(c) for c in cols]),
+                          jnp.asarray(idx), mode="clip")
         out_cols[name] = Column(typ, data, valid,
                                 merged if is_string(typ) else None, d2)
     return Batch(out_cols, total)

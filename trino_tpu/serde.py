@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import threading
 import struct
 import subprocess
 from typing import Dict, Optional
@@ -49,13 +50,37 @@ _DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
 
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_TRIED = False
+_LIB_LOCK = threading.Lock()
 
 
 def _load_native() -> Optional[ctypes.CDLL]:
     global _LIB, _LIB_TRIED
     if _LIB_TRIED:
         return _LIB
-    _LIB_TRIED = True
+    with _LIB_LOCK:
+        return _load_native_locked()
+
+
+def _load_native_locked() -> Optional[ctypes.CDLL]:
+    """Must hold _LIB_LOCK. The flag flips only AFTER the load settles:
+    a concurrent first call must block, not observe a half-initialized
+    state — a worker thread that raced here used to fall back to
+    crc32/STORE framing while its peers (and the coordinator) used
+    xxh64/LZ4, surfacing as flaky 'page checksum mismatch' on tiny
+    pages serialized inside the race window."""
+    global _LIB, _LIB_TRIED
+    if _LIB_TRIED:
+        return _LIB
+    try:
+        _LIB = _do_load()
+    finally:
+        # flips LAST so the unlocked fast path can never observe
+        # TRIED=True with the load still in flight
+        _LIB_TRIED = True
+    return _LIB
+
+
+def _do_load() -> Optional[ctypes.CDLL]:
     here = os.path.dirname(os.path.abspath(__file__))
     so = os.path.join(here, "native", "libpageserde.so")
     src = os.path.normpath(os.path.join(here, "..", "native",
@@ -89,8 +114,7 @@ def _load_native() -> Optional[ctypes.CDLL]:
         fn = getattr(lib, name)
         fn.restype = restype
         fn.argtypes = argtypes
-    _LIB = lib
-    return _LIB
+    return lib
 
 
 def native_available() -> bool:

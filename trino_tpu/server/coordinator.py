@@ -202,13 +202,18 @@ class Coordinator:
 
     def __init__(self, port: int = 0, distributed: bool = False,
                  catalogs=None, resource_groups=None,
-                 event_listeners=None, authenticator=None):
+                 event_listeners=None, authenticator=None,
+                 worker_uris=None):
         from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
         self._distributed = distributed
         self._catalogs = catalogs
         self.authenticator = authenticator
+        # remote worker fleet: queries dispatch leaf fragments to these
+        # processes (exec/remote.py; reference: DiscoveryNodeManager's
+        # active worker set feeding SqlQueryScheduler)
+        self.workers = list(worker_uris or [])
 
         # one shared CatalogManager (memory-connector state spans
         # queries) and one shared mesh
@@ -219,7 +224,14 @@ class Coordinator:
         from ..connectors.system import SystemConnector
         self._catalogs.register("system", SystemConnector(self))
 
-        def make_runner(session: Session) -> LocalQueryRunner:
+        def make_runner(session: Session):
+            detector = getattr(self, "failure_detector", None)
+            live = [w for w in self.workers
+                    if detector is None or detector.is_alive(w)]
+            if live:
+                from ..exec.remote import DistributedHostQueryRunner
+                return DistributedHostQueryRunner(
+                    live, session=session, catalogs=self._catalogs)
             return LocalQueryRunner(session=session,
                                     catalogs=self._catalogs,
                                     mesh=self._proto.mesh)

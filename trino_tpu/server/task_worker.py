@@ -76,8 +76,21 @@ class _Task:
                               schema=payload.get("schema"))
             for name, value in payload.get("properties", {}).items():
                 session.set(name, value)
-            runner = LocalQueryRunner(session=session)
-            res = runner.execute_batch(payload["sql"])
+            if "fragment" in payload:
+                # serialized PlanFragment + split share — the remote
+                # task path (reference: SqlTaskManager.java:370-403
+                # executing a TaskUpdateRequest's fragment)
+                from ..exec.executor import Executor
+                from ..plan.serde import from_jsonable
+                runner = LocalQueryRunner(session=session)
+                plan = from_jsonable(payload["fragment"])
+                ex = Executor(runner.catalogs, session)
+                ex.scan_partition = (int(payload["part"]),
+                                     int(payload["nparts"]))
+                res = ex.execute(plan)
+            else:
+                runner = LocalQueryRunner(session=session)
+                res = runner.execute_batch(payload["sql"])
             self.pages = paginate(res)
             self.state = "FINISHED"
         except Exception as e:   # noqa: BLE001
@@ -275,9 +288,23 @@ class RemoteTaskClient:
 
     def submit(self, task_id: str, sql: str, catalog: str = "tpch",
                schema: str = "tiny", properties: Optional[dict] = None):
-        payload = json.dumps({"sql": sql, "catalog": catalog,
-                              "schema": schema,
-                              "properties": properties or {}}).encode()
+        return self._post(task_id, {"sql": sql, "catalog": catalog,
+                                    "schema": schema,
+                                    "properties": properties or {}})
+
+    def submit_fragment(self, task_id: str, fragment: dict,
+                        catalog: str, schema: str, part: int,
+                        nparts: int,
+                        properties: Optional[dict] = None):
+        """POST a serialized plan fragment + split share (the
+        HttpRemoteTask TaskUpdateRequest analog)."""
+        return self._post(task_id, {
+            "fragment": fragment, "catalog": catalog, "schema": schema,
+            "part": part, "nparts": nparts,
+            "properties": properties or {}})
+
+    def _post(self, task_id: str, body: dict):
+        payload = json.dumps(body).encode()
         req = urllib.request.Request(
             f"{self.base_uri}/v1/task/{task_id}", data=payload,
             headers={"Content-Type": "application/json"}, method="POST")
