@@ -126,6 +126,20 @@ def test_remote_topn_pushdown(workers):
            "ORDER BY o_totalprice DESC LIMIT 10", approx_cols=(1,))
 
 
+def test_remote_cancel_aborts_task(workers):
+    """A set cancel event makes the page pull abort the remote task and
+    raise instead of blocking until completion."""
+    import threading
+    from trino_tpu.server.task_worker import RemoteTaskClient
+    c = RemoteTaskClient(workers[0])
+    c.submit("cancel-me", "SELECT count(*) FROM lineitem l1, nation",
+             catalog="tpch", schema="tiny")
+    ev = threading.Event()
+    ev.set()
+    with pytest.raises(RuntimeError, match="canceled"):
+        c.pages("cancel-me", cancel=ev)
+
+
 def test_remote_decimal_aggregates_exact(workers):
     """Decimal sum/avg through remote partial/final must be bit-exact
     vs local (no approx): the avg reconstruction divides the Int128 sum

@@ -196,6 +196,21 @@ class Column:
         return replace(self, data=codes, dictionary=dictionary)
 
 
+def hi_lane_or_fill(col: "Column"):
+    """``col.data2`` as a jnp lane, synthesized when absent: Int128
+    decimal columns sign-extend (a negative lo zero-filled would be off
+    by 2^64); every other data2 carrier (timestamptz offset, varchar
+    length lane) fills with zeros. The single source of truth for
+    concat sites merging mixed-representation parts."""
+    import jax.numpy as jnp
+    from .types import DecimalType
+    if col.data2 is not None:
+        return jnp.asarray(col.data2)
+    if isinstance(col.type, DecimalType):
+        return jnp.asarray(col.data).astype(jnp.int64) >> 63
+    return jnp.zeros((col.capacity,), jnp.int64)
+
+
 def _to_lane(values, typ: Type):
     """numpy-ify a python sequence for a non-string column; returns
     (data, valid|None, data2|None). ``data2`` is the Int128 high lane,

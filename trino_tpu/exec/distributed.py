@@ -682,17 +682,10 @@ def _trace_concat(a: Batch, b: Batch, out_cap: int) -> Batch:
             valid = jnp.take(jnp.concatenate([va, vb]), idx, mode="clip")
         d2 = None
         if ca.data2 is not None or cb.data2 is not None:
-            from ..types import DecimalType as _Dec
-            dec_hi = isinstance(ca.type, _Dec)
-
-            def _hi(c):
-                if c.data2 is not None:
-                    return jnp.asarray(c.data2)
-                if dec_hi:   # sign-extend a missing Int128 hi lane
-                    return jnp.asarray(c.data).astype(jnp.int64) >> 63
-                return jnp.zeros((c.capacity,), jnp.int64)
-            d2 = jnp.take(jnp.concatenate([_hi(ca), _hi(cb)]), idx,
-                          mode="clip")
+            from ..columnar import hi_lane_or_fill
+            d2 = jnp.take(jnp.concatenate(
+                [hi_lane_or_fill(ca), hi_lane_or_fill(cb)]), idx,
+                mode="clip")
         cols[name] = Column(ca.type, data, valid, ca.dictionary,
                             data2=d2)
     return Batch(cols, na + nb)

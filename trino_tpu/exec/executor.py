@@ -1852,19 +1852,10 @@ def device_concat(parts: Sequence[Batch]) -> Batch:
                              mode="clip")
         d2 = None
         if any(c.data2 is not None for c in cols):
-            # a missing hi lane means sign-extension for Int128 decimal
-            # columns (a negative lo zero-filled would be off by 2^64);
-            # timestamptz offsets fill with zeros (UTC)
-            dec_hi = isinstance(typ, DecimalType)
-
-            def _fill(c):
-                if c.data2 is not None:
-                    return jnp.asarray(c.data2)
-                if dec_hi:
-                    return jnp.asarray(c.data).astype(jnp.int64) >> 63
-                return jnp.zeros((c.capacity,), jnp.int64)
-            d2 = jnp.take(jnp.concatenate([_fill(c) for c in cols]),
-                          jnp.asarray(idx), mode="clip")
+            from ..columnar import hi_lane_or_fill
+            d2 = jnp.take(
+                jnp.concatenate([hi_lane_or_fill(c) for c in cols]),
+                jnp.asarray(idx), mode="clip")
         out_cols[name] = Column(typ, data, valid,
                                 merged if is_string(typ) else None, d2)
     return Batch(out_cols, total)

@@ -253,7 +253,8 @@ class RemoteScheduler:
                     catalog=session.catalog, schema=session.schema,
                     part=wi, nparts=nparts,
                     properties=dict(session.properties))
-                pages = client.pages(tid)
+                pages = client.pages(
+                    tid, cancel=getattr(session, "cancel", None))
                 results[f.fid][wi] = (device_concat(pages)
                                       if len(pages) > 1 else
                                       pages[0] if pages else None)

@@ -139,7 +139,15 @@ class TaskWorkerServer:
                     if t is None:
                         self.send_error(404)
                         return
-                    t.done.wait(timeout=300)
+                    # short-poll: a still-running task answers 202 so
+                    # the puller can notice cancellation between polls
+                    # (reference: TaskResource's bounded long-poll)
+                    if not t.done.wait(timeout=2.0) \
+                            and t.state == "RUNNING":
+                        self.send_response(202)
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
                     if t.state != "FINISHED":
                         # still RUNNING (wait timed out), FAILED, or
                         # CANCELED — never report an empty complete
@@ -311,14 +319,24 @@ class RemoteTaskClient:
         with urllib.request.urlopen(req, timeout=30) as r:
             return json.loads(r.read())
 
-    def pages(self, task_id: str) -> List[Batch]:
-        """Pull every result page (token-acknowledged long-poll)."""
+    def pages(self, task_id: str, cancel=None) -> List[Batch]:
+        """Pull every result page (token-acknowledged bounded poll).
+        ``cancel`` (a threading.Event) aborts the remote task and
+        raises between polls — the ExchangeClient cancel path."""
         out: List[Batch] = []
         token = 0
         while True:
+            if cancel is not None and cancel.is_set():
+                try:
+                    self.abort(task_id)
+                except Exception:       # noqa: BLE001
+                    pass
+                raise RuntimeError(f"task {task_id} canceled")
             with urllib.request.urlopen(
                     f"{self.base_uri}/v1/task/{task_id}/results/{token}",
                     timeout=600) as r:
+                if r.status == 202:     # still running: poll again
+                    continue
                 complete = r.headers.get("X-TT-Complete") == "true"
                 body = r.read()
             if complete:
