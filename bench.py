@@ -149,17 +149,43 @@ def _leg_engine(schema: str, iters: int) -> float:
     return rows / best
 
 
+def _leg_q18(schema: str) -> float:
+    """rows/sec of TPC-H q18 (BASELINE configs[3] shape: large
+    build-side join + IN-subquery semi-join) through the full engine.
+    Device-only: lineitem/orders lanes generate directly in HBM
+    (connectors/tpch_device.py)."""
+    import trino_tpu  # noqa: F401
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.connectors.tpch import SCHEMAS, table_rows
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.session import Session
+
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema=schema))
+    rows = table_rows("orders", SCHEMAS[schema]) * 4  # ~lineitem rows
+    res = r.execute(TPCH_QUERIES[18])    # generate + compile + warm
+    # tiny legitimately has zero orders over the HAVING>300 bar
+    assert len(res.rows) > 0 or schema == "tiny"
+    t0 = time.perf_counter()
+    res = r.execute(TPCH_QUERIES[18])
+    dt = time.perf_counter() - t0
+    return rows / dt
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
     if kind == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    legs = ([("engine", lambda: _leg_engine("sf1", 2)),
-             ("micro", lambda: _leg_micro(1.0, 3))]
-            if kind == "device" else
-            [("engine", lambda: _leg_engine("sf1", 2)),
-             ("micro", lambda: _leg_micro(0.1, 2))])
+    if kind == "scale":
+        sf = os.environ.get("BENCH_Q18_SCHEMA", "sf10")
+        legs = [("q18", lambda: _leg_q18(sf))]
+    elif kind == "device":
+        legs = [("engine", lambda: _leg_engine("sf1", 2)),
+                ("micro", lambda: _leg_micro(1.0, 3))]
+    else:
+        legs = [("engine", lambda: _leg_engine("sf1", 2)),
+                ("micro", lambda: _leg_micro(0.1, 2))]
     for name, fn in legs:
         try:
             rps = fn()
@@ -212,7 +238,8 @@ def _probe(kind: str, timeout: float):
             errs[d.get("leg", "?")] = d["error"]
     if err_note:
         errs.setdefault("probe", err_note)
-    for leg in ("engine", "micro"):   # a 0.0 must never be unexplained
+    expected = ("q18",) if kind == "scale" else ("engine", "micro")
+    for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
     return vals, errs
@@ -267,6 +294,19 @@ def main():
     if cpu_budget > 30:
         cpu_vals, cpu_errs = _probe("cpu", cpu_budget)
 
+    # --- scale leg: q18 @ sf10 (BASELINE configs[3] direction) --------
+    # only when the core legs landed and real budget remains; failure
+    # here never harms the primary metric
+    scale_vals, scale_errs = {}, {}
+    q18_schema = os.environ.get("BENCH_Q18_SCHEMA", "sf10")
+    if dev_vals.get("engine") and _remaining() > 180:
+        scale_vals, scale_errs = _probe("scale",
+                                        min(_remaining() - 30, 420))
+    else:
+        scale_errs["q18"] = ("skipped: engine leg missing"
+                             if not dev_vals.get("engine")
+                             else "skipped: insufficient budget")
+
     tpu_eng = dev_vals.get("engine")
     tpu_micro = dev_vals.get("micro")
     cpu_eng = cpu_vals.get("engine")
@@ -288,9 +328,19 @@ def main():
                          if tpu_micro and cpu_micro else 0.0),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
+        # BASELINE configs[3] direction: q18 at scale. sf100 lineitem
+        # (~600M rows, ~34GB of q18-relevant lanes) exceeds one chip's
+        # HBM; it needs the chunk-streamed probe join — recorded as the
+        # bound until that lands.
+        f"q18_{q18_schema}_rows_per_sec":
+            round(scale_vals.get("q18", 0.0), 1),
+        "q18_sf100": "not attempted: ~600M-row lineitem (~34GB of q18 "
+                     "lanes) exceeds single-chip HBM; needs "
+                     "chunk-streamed probe join",
     }
     errs = {**{f"device_{k}": v for k, v in dev_errs.items()},
-            **{f"cpu_{k}": v for k, v in cpu_errs.items()}}
+            **{f"cpu_{k}": v for k, v in cpu_errs.items()},
+            **{f"scale_{k}": v for k, v in scale_errs.items()}}
     if errs:
         report["errors"] = json.dumps(errs)[:500]
     state["report"] = report

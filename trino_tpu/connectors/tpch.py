@@ -450,6 +450,10 @@ class TpchConnector(Connector):
             for c, _ in handle.constraint.domains:
                 if c not in gen_cols:
                     gen_cols.append(c)
+        dev = self._read_split_device(split, sf, table, handle, gen_cols,
+                                      columns)
+        if dev is not None:
+            return dev
         if table == "region":
             out = self._region(gen_cols)
         elif table == "nation":
@@ -468,6 +472,40 @@ class TpchConnector(Connector):
             from ..predicate import filter_batch_host
             out = filter_batch_host(out, handle.constraint,
                                     handle.limit)
+            out = out.select_columns(list(columns))
+        return out
+
+    def _read_split_device(self, split: Split, sf: float, table: str,
+                           handle, gen_cols, columns) -> Optional[Batch]:
+        """Generate the split's lanes ON DEVICE when the backend is an
+        accelerator and every requested column is device-generatable
+        (tpch_device.py): at sf>=10 host generation is the bottleneck —
+        600M sf100 lineitem rows would take minutes on a 1-core host
+        before the first byte reaches HBM. Opt out with
+        TRINO_TPU_DEVICE_GEN=0 (or force on CPU with =1 for tests)."""
+        import os
+        mode = os.environ.get("TRINO_TPU_DEVICE_GEN", "auto")
+        if mode == "0":
+            return None
+        if mode != "1":
+            import jax
+            if jax.default_backend() == "cpu":
+                return None
+        from .tpch_device import (device_columns, device_filter,
+                                  lineitem_batch, orders_batch)
+        allowed = device_columns(table)
+        if allowed is None or not set(gen_cols) <= allowed:
+            return None
+        if table == "lineitem":
+            units = table_rows("orders", sf)
+        else:
+            units = table_rows(table, sf)
+        lo = split.part * units // split.part_count
+        hi = (split.part + 1) * units // split.part_count
+        gen = lineitem_batch if table == "lineitem" else orders_batch
+        out = gen(lo, hi, sf, list(gen_cols))
+        if handle.constraint is not None or handle.limit is not None:
+            out = device_filter(out, handle.constraint, handle.limit)
             out = out.select_columns(list(columns))
         return out
 

@@ -600,6 +600,23 @@ class Executor:
             return Batch(cols, whole.num_rows)
         whole = read_table_cached(conn, node.handle, columns, par)
         if whole is None:
+            # materializing the table for a downstream operator: check
+            # the memory guard FIRST so an over-limit table fails with
+            # the actionable EXCEEDED_LOCAL_MEMORY_LIMIT error instead
+            # of exhausting HBM mid-concat (memory/MemoryPool.java's
+            # reserve-before-allocate discipline)
+            est = None
+            if node.handle.constraint is None \
+                    and node.handle.limit is None \
+                    and hasattr(conn, "table_row_count"):
+                # pushed-down constraints/limits shrink the result below
+                # the table row count by an unknown factor — reserving
+                # the full-table estimate would spuriously reject
+                # selective scans (q6@sf100 keeps ~2% of rows)
+                est = conn.table_row_count(node.handle)
+            if est:
+                self._reserve(int(est), len(columns),
+                              f"table scan of {node.handle.table}")
             splits = conn.get_splits(node.handle, par)
             batches = [read_split_cached(conn, s, columns)
                        for s in splits]

@@ -319,13 +319,25 @@ class RemoteTaskClient:
         with urllib.request.urlopen(req, timeout=30) as r:
             return json.loads(r.read())
 
-    def pages(self, task_id: str, cancel=None) -> List[Batch]:
+    def pages(self, task_id: str, cancel=None,
+              timeout_s: float = 600.0) -> List[Batch]:
         """Pull every result page (token-acknowledged bounded poll).
         ``cancel`` (a threading.Event) aborts the remote task and
-        raises between polls — the ExchangeClient cancel path."""
+        raises between polls — the ExchangeClient cancel path;
+        ``timeout_s`` bounds the total wait on a wedged task (the old
+        long-poll's 300s server bound, now client-side)."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
         out: List[Batch] = []
         token = 0
         while True:
+            if _time.monotonic() > deadline:
+                try:
+                    self.abort(task_id)
+                except Exception:       # noqa: BLE001
+                    pass
+                raise RuntimeError(
+                    f"task {task_id} produced no page for {timeout_s}s")
             if cancel is not None and cancel.is_set():
                 try:
                     self.abort(task_id)
