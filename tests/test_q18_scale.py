@@ -1,0 +1,30 @@
+"""q18 at scale vs the independent numpy oracle (BASELINE configs[3]
+direction; the sqlite oracle tier stops at tiny).
+
+Gated like tests/test_scale.py: sf1 engine + oracle passes cost minutes
+on the 1-core CI box."""
+
+import datetime
+import os
+
+import pytest
+
+from trino_tpu.benchmarks.q18_oracle import q18_oracle
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("TRINO_TPU_SCALE_TESTS") != "1",
+    reason="scale tests are opt-in (TRINO_TPU_SCALE_TESTS=1)")
+from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+
+EPOCH = datetime.date(1970, 1, 1)
+
+
+def test_q18_sf1_matches_numpy_oracle():
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema="sf1"))
+    got = r.execute(TPCH_QUERIES[18]).rows
+    exp = q18_oracle(1.0)
+    assert len(got) == len(exp) > 0
+    for g, e in zip(got, exp):
+        assert [g[0], g[1], g[2], (g[3] - EPOCH).days, g[4], g[5]] == e
