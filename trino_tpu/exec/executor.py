@@ -1129,51 +1129,12 @@ class Executor:
     def _exec_SetOpNode(self, node: SetOpNode) -> Batch:
         left = self.execute(node.left)
         right = self.execute(node.right)
-        out_syms = list(node.schema)
         lb = Batch({o: left.column(i) for o, i in node.left_map.items()},
                    left.num_rows)
         rb = Batch({o: right.column(i)
                     for o, i in node.right_map.items()}, right.num_rows)
-        # tag sides, group by all columns, filter on per-side counts
-        # (reference rules: ImplementIntersectDistinctAsUnion etc.)
-        tagged = []
-        for b, (lc, rc) in ((lb, (1, 0)), (rb, (0, 1))):
-            cols = dict(b.columns)
-            cols["__l$"] = Column(
-                BIGINT, jnp.full((b.capacity,), lc, jnp.int64), None)
-            cols["__r$"] = Column(
-                BIGINT, jnp.full((b.capacity,), rc, jnp.int64), None)
-            tagged.append(Batch(cols, b.num_rows))
-        both = device_concat(tagged)
-        aggs = [AggInput("sum", "__l$", output="__nl$"),
-                AggInput("sum", "__r$", output="__nr$")]
-        g = group_aggregate(both, out_syms, aggs)
-        nl = jnp.asarray(g.column("__nl$").data)
-        nr = jnp.asarray(g.column("__nr$").data)
-        if node.op == "intersect":
-            keep = (nl > 0) & (nr > 0)
-        elif node.distinct:
-            keep = (nl > 0) & (nr == 0)
-        else:
-            # EXCEPT ALL keeps rows with nl > nr, replicated nl-nr times
-            # (iterative/rule/ImplementExceptAll.java semantics)
-            keep = nl > nr
-        out = compact.filter_batch(g, keep)
-        if not node.distinct:
-            # ALL semantics: replicate each row min/max-difference times
-            times = (jnp.minimum(nl, nr) if node.op == "intersect"
-                     else jnp.maximum(nl - nr, 0))
-            times = jnp.take(times,
-                             compact.mask_to_gather(keep)[0])
-            total = int(jnp.sum(jnp.where(out.row_valid(), times, 0)))
-            cap = capacity_for(max(total, 1))
-            incl = jnp.cumsum(jnp.where(out.row_valid(), times, 0))
-            offs = incl - times
-            i = jnp.arange(cap, dtype=jnp.int64)
-            p = jnp.searchsorted(incl, i, side="right")
-            p = jnp.clip(p, 0, out.capacity - 1)
-            out = out.gather(p, total)
-        return Batch({s: out.column(s) for s in out_syms}, out.num_rows)
+        return setop_batches(lb, rb, node.op, node.distinct,
+                             list(node.schema))
 
     # ------------------------------------------------------------------
     # windows
@@ -1191,6 +1152,66 @@ class Executor:
 
     def _single_row(self, src: Batch) -> Batch:
         return _single_row(src)
+
+
+def setop_tag(lb: Batch, rb: Batch):
+    """Tag each side with per-side counters for the group-by counting
+    kernel (reference rules: ImplementIntersectDistinctAsUnion,
+    ImplementExceptAll). Shared by the local and distributed paths."""
+    tagged = []
+    for b, (lc, rc) in ((lb, (1, 0)), (rb, (0, 1))):
+        cols = dict(b.columns)
+        cols["__l$"] = Column(
+            BIGINT, jnp.full((b.capacity,), lc, jnp.int64), None)
+        cols["__r$"] = Column(
+            BIGINT, jnp.full((b.capacity,), rc, jnp.int64), None)
+        tagged.append(Batch(cols, b.num_rows))
+    return tagged
+
+
+SETOP_AGGS = (AggInput("sum", "__l$", output="__nl$"),
+              AggInput("sum", "__r$", output="__nr$"))
+
+
+def setop_keep_times(nl, nr, op: str, distinct: bool):
+    """(keep-mask, replication-times|None) from the per-side counts —
+    the set-op semantics in one place (EXCEPT ALL keeps rows with
+    nl > nr replicated nl-nr times; INTERSECT ALL min(nl, nr))."""
+    if op == "intersect":
+        keep = (nl > 0) & (nr > 0)
+    elif distinct:
+        keep = (nl > 0) & (nr == 0)
+    else:
+        keep = nl > nr
+    if distinct:
+        return keep, None
+    times = (jnp.minimum(nl, nr) if op == "intersect"
+             else jnp.maximum(nl - nr, 0))
+    return keep, times
+
+
+def setop_batches(lb: Batch, rb: Batch, op: str, distinct: bool,
+                  out_syms) -> Batch:
+    """INTERSECT/EXCEPT [ALL] over two schema-aligned batches.
+    Batch-level so the distributed executor can run the same kernel per
+    shard after a hash repartition on all columns (its traced twin in
+    exec/distributed.py differs only in concat + host syncs)."""
+    both = device_concat(setop_tag(lb, rb))
+    g = group_aggregate(both, out_syms, list(SETOP_AGGS))
+    nl = jnp.asarray(g.column("__nl$").data)
+    nr = jnp.asarray(g.column("__nr$").data)
+    keep, times = setop_keep_times(nl, nr, op, distinct)
+    out = compact.filter_batch(g, keep)
+    if times is not None:
+        times = jnp.take(times, compact.mask_to_gather(keep)[0])
+        total = int(jnp.sum(jnp.where(out.row_valid(), times, 0)))
+        cap = capacity_for(max(total, 1))
+        incl = jnp.cumsum(jnp.where(out.row_valid(), times, 0))
+        i = jnp.arange(cap, dtype=jnp.int64)
+        p = jnp.searchsorted(incl, i, side="right")
+        p = jnp.clip(p, 0, out.capacity - 1)
+        out = out.gather(p, total)
+    return Batch({s: out.column(s) for s in out_syms}, out.num_rows)
 
 
 _TRACEABLE = (FilterNode, ProjectNode, LimitNode, OffsetNode, SortNode,
