@@ -1,0 +1,156 @@
+"""Plan assertions for the round-5 optimizer rules.
+
+Reference: sql/planner/iterative/rule/{UnwrapCastInComparison,
+SingleDistinctAggregationToGroupBy, CreatePartialTopN,
+PushdownFilterIntoWindow}.java.
+"""
+
+import pytest
+
+from trino_tpu.plan.nodes import (AggregationNode, FilterNode,
+                                  LimitNode, TableScanNode, TopNNode,
+                                  UnionNode, WindowNode)
+from trino_tpu.planner.logical import LogicalPlanner
+from trino_tpu.planner.optimizer import optimize
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+from trino_tpu.sql.parser import parse_statement
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(session=Session(catalog="tpch",
+                                            schema="tiny"))
+
+
+def _plan(runner, sql):
+    stmt = parse_statement(sql)
+    return optimize(LogicalPlanner(runner.catalogs, runner.session)
+                    .plan(stmt), runner.catalogs, runner.session)
+
+
+def _find(node, cls):
+    out = []
+    if isinstance(node, cls):
+        out.append(node)
+    for s in node.sources:
+        out.extend(_find(s, cls))
+    return out
+
+
+def test_unwrap_cast_enables_scan_pushdown(runner):
+    # cast(integer-ish col to DOUBLE) compared to a double literal:
+    # unwrapping lets the domain reach the connector handle
+    plan = _plan(runner,
+                 "SELECT count(*) FROM orders "
+                 "WHERE CAST(o_shippriority AS DOUBLE) = 0.0")
+    scans = _find(plan, TableScanNode)
+    assert len(scans) == 1
+    assert scans[0].handle.constraint is not None
+    assert not _find(plan, FilterNode)   # fully absorbed by the scan
+
+    rows = runner.execute(
+        "SELECT count(*) FROM orders "
+        "WHERE CAST(o_shippriority AS DOUBLE) = 0.0").rows
+    assert rows[0][0] == 15000
+
+
+def test_unwrap_cast_nonintegral_bound(runner):
+    got = runner.execute(
+        "SELECT count(*) FROM orders "
+        "WHERE CAST(o_shippriority AS DOUBLE) < 0.5").rows
+    assert got[0][0] == 15000
+    got = runner.execute(
+        "SELECT count(*) FROM orders "
+        "WHERE CAST(o_shippriority AS DOUBLE) > 0.5").rows
+    assert got[0][0] == 0
+
+
+def test_single_distinct_becomes_groupby(runner):
+    plan = _plan(runner,
+                 "SELECT o_orderpriority, count(DISTINCT o_custkey) "
+                 "FROM orders GROUP BY o_orderpriority")
+    aggs = _find(plan, AggregationNode)
+    assert len(aggs) == 2     # outer plain agg over inner dedup
+    outer, inner = aggs
+    assert all(not a.distinct for a in outer.aggregates.values())
+    assert not inner.aggregates      # pure GROUP BY dedup
+    assert set(inner.group_keys) >= set(outer.group_keys)
+
+    got = runner.execute(
+        "SELECT o_orderpriority, count(DISTINCT o_custkey) c "
+        "FROM orders GROUP BY o_orderpriority ORDER BY 1").rows
+    exp = runner.execute(
+        "SELECT o_orderpriority, count(*) FROM ("
+        "  SELECT DISTINCT o_orderpriority, o_custkey FROM orders) "
+        "GROUP BY o_orderpriority ORDER BY 1").rows
+    assert got == exp
+
+
+def test_mixed_distinct_not_rewritten(runner):
+    # a non-distinct aggregate alongside: rewrite must NOT fire
+    plan = _plan(runner,
+                 "SELECT count(DISTINCT o_custkey), count(*) "
+                 "FROM orders")
+    aggs = _find(plan, AggregationNode)
+    assert len(aggs) == 1
+
+
+def test_partial_topn_through_union(runner):
+    plan = _plan(runner,
+                 "SELECT * FROM ("
+                 "  SELECT o_orderkey AS k FROM orders"
+                 "  UNION ALL SELECT c_custkey FROM customer) "
+                 "ORDER BY k DESC LIMIT 7")
+    tops = _find(plan, TopNNode)
+    finals = [t for t in tops if t.step == "FINAL"]
+    partials = [t for t in tops if t.step == "PARTIAL"]
+    assert len(finals) == 1 and len(partials) == 2
+    u = _find(plan, UnionNode)[0]
+    assert all(isinstance(c, TopNNode) for c in u.children)
+
+    got = runner.execute(
+        "SELECT * FROM (SELECT o_orderkey AS k FROM orders "
+        "UNION ALL SELECT c_custkey FROM customer) "
+        "ORDER BY k DESC LIMIT 7").rows
+    assert len(got) == 7
+    assert got == sorted(got, reverse=True)
+
+
+def test_partial_limit_through_union(runner):
+    plan = _plan(runner,
+                 "SELECT * FROM (SELECT o_orderkey AS k FROM orders "
+                 "UNION ALL SELECT c_custkey FROM customer) LIMIT 9")
+    u = _find(plan, UnionNode)[0]
+    assert all(isinstance(c, LimitNode) and c.partial
+               for c in u.children)
+    got = runner.execute(
+        "SELECT * FROM (SELECT o_orderkey AS k FROM orders "
+        "UNION ALL SELECT c_custkey FROM customer) LIMIT 9").rows
+    assert len(got) == 9
+
+
+def test_filter_pushes_into_window_partition(runner):
+    sql = ("SELECT * FROM ("
+           "  SELECT o_custkey, o_orderkey, "
+           "  rank() OVER (PARTITION BY o_custkey "
+           "               ORDER BY o_totalprice) r"
+           "  FROM orders) WHERE o_custkey = 370")
+    plan = _plan(runner, sql)
+    win = _find(plan, WindowNode)[0]
+    # the partition-key conjunct moved below the window (ideally all
+    # the way into the scan handle)
+    below = _find(win, (FilterNode, TableScanNode))
+    pushed = any(
+        isinstance(n, FilterNode) or
+        (isinstance(n, TableScanNode) and n.handle.constraint is not None)
+        for n in below)
+    assert pushed
+    assert not _find(plan, FilterNode) or _find(win, FilterNode)
+
+    got = runner.execute(sql + " ORDER BY r").rows
+    exp = [r for r in runner.execute(
+        "SELECT o_custkey, o_orderkey, rank() OVER ("
+        "PARTITION BY o_custkey ORDER BY o_totalprice) r FROM orders "
+        "ORDER BY r").rows if r[0] == 370]
+    assert got == exp

@@ -33,7 +33,9 @@ from ..rex import Call, Const, InputRef, RowExpr, TRUE
 
 
 def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
+    plan = unwrap_casts(plan)
     plan = push_filters(plan)
+    plan = single_distinct_to_groupby(plan)
     if catalogs is not None:
         from .stats import choose_join_sides, reorder_joins
         force = "AUTOMATIC"
@@ -49,6 +51,7 @@ def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
         plan = choose_join_sides(plan, catalogs, force)
         if pushdown:
             plan = push_into_scan(plan, catalogs)
+    plan = partial_topn_through_union(plan)
     plan = prune_columns(plan)
     plan = cleanup_projects(plan)
     return plan
@@ -232,15 +235,32 @@ def _push(node: PlanNode, conjuncts: List[RowExpr]) -> PlanNode:
         src = _push(node.source, down)
         return _wrap(dc_replace(node, source=src), keep)
 
+    if isinstance(node, WindowNode):
+        # DETERMINISTIC conjuncts over the PARTITION BY keys push below
+        # the window: dropping whole partitions cannot change surviving
+        # rows' window values. A volatile conjunct (random() < x) would
+        # thin partitions instead of dropping them whole.
+        # (iterative/rule/PushdownFilterIntoWindow.java /
+        # PushdownFilterIntoRowNumber.java)
+        from ..exec.executor import _expr_volatile
+        pkeys = set(node.partition_by)
+
+        def pushable(c):
+            return rex.input_names(c) <= pkeys and not _expr_volatile(c)
+        down = [c for c in conjuncts if pushable(c)]
+        keep = [c for c in conjuncts if not pushable(c)]
+        src = _push(node.source, down)
+        return _wrap(dc_replace(node, source=src), keep)
+
     if isinstance(node, (SortNode, MarkDistinctNode, AssignUniqueIdNode,
-                         SampleNode, EnforceSingleRowNode, WindowNode,
+                         SampleNode, EnforceSingleRowNode,
                          ExchangeNode)):
         src = _push(node.sources[0], conjuncts
                     if not isinstance(node, (EnforceSingleRowNode,
-                                             WindowNode, SampleNode))
+                                             SampleNode))
                     else [])
         rest = (conjuncts if isinstance(node, (EnforceSingleRowNode,
-                                               WindowNode, SampleNode))
+                                               SampleNode))
                 else [])
         return _wrap(dc_replace(node, source=src), rest)
 
@@ -532,4 +552,196 @@ def cleanup_projects(node: PlanNode) -> PlanNode:
     if isinstance(node, SetOpNode):
         return dc_replace(node, left=cleanup_projects(node.left),
                           right=cleanup_projects(node.right))
+    return node
+
+
+# --------------------------------------------------------------------------
+# UnwrapCastInComparison (iterative/rule/UnwrapCastInComparison.java):
+# CAST(col AS wider) CMP literal  ->  col CMP narrowed-literal, which
+# unlocks domain pushdown into the scan for the uncast column.
+# --------------------------------------------------------------------------
+
+_CMPS = {"=", "<>", "<", "<=", ">", ">="}
+_FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+         "=": "=", "<>": "<>"}
+_INT_ORDER = ["tinyint", "smallint", "integer", "bigint"]
+_INT_RANGE = {"tinyint": (-2 ** 7, 2 ** 7 - 1),
+              "smallint": (-2 ** 15, 2 ** 15 - 1),
+              "integer": (-2 ** 31, 2 ** 31 - 1),
+              "bigint": (-2 ** 63, 2 ** 63 - 1)}
+
+
+def _unwrap_cmp(fn: str, cast: rex.Cast, const: Const):
+    """The rewritten comparison, or None when not provably safe."""
+    import math
+    if not isinstance(cast.arg, InputRef) or cast.safe:
+        return None
+    s = cast.arg.type
+    t = cast.type
+    v = const.value
+    if v is None:
+        return None
+    s_name = getattr(s, "name", "")
+    t_name = getattr(t, "name", "")
+    if s_name in _INT_ORDER and t_name in _INT_ORDER \
+            and _INT_ORDER.index(t_name) > _INT_ORDER.index(s_name):
+        lo, hi = _INT_RANGE[s_name]
+        if lo <= int(v) <= hi:
+            return Call(fn, (cast.arg, Const(int(v), s)),
+                        rex.TRUE.type)
+        return None   # out-of-range: constant-fold territory, skip
+    if s_name in ("tinyint", "smallint", "integer") \
+            and t_name == "double":
+        # bigint deliberately excluded: values above 2^53 are not exact
+        # in double, so the unwrap would change results (the reference
+        # rule proves round-trip exactness; int32 and below always
+        # round-trip)
+        fv = float(v)
+        if not math.isfinite(fv):
+            return None
+        lo, hi = _INT_RANGE[s_name]
+        if fv == math.floor(fv) and lo <= fv <= hi:
+            return Call(fn, (cast.arg, Const(int(fv), s)),
+                        rex.TRUE.type)
+        if fn in ("<", "<=", ">", ">=") and lo <= fv <= hi:
+            # non-integral bound: snap to the neighboring integer
+            if fn in ("<", "<="):
+                return Call("<=", (cast.arg,
+                                   Const(math.floor(fv), s)),
+                            rex.TRUE.type)
+            return Call(">=", (cast.arg, Const(math.ceil(fv), s)),
+                        rex.TRUE.type)
+    return None
+
+
+def _unwrap_expr(e: RowExpr) -> RowExpr:
+    if isinstance(e, Call):
+        args = tuple(_unwrap_expr(a) for a in e.args)
+        if e.fn in _CMPS and len(args) == 2:
+            a, b = args
+            fn = e.fn
+            if isinstance(b, rex.Cast) and isinstance(a, Const):
+                a, b, fn = b, a, _FLIP[e.fn]
+                out = _unwrap_cmp(fn, a, b)
+            elif isinstance(a, rex.Cast) and isinstance(b, Const):
+                out = _unwrap_cmp(fn, a, b)
+            else:
+                out = None
+            if out is not None:
+                return out
+        if args != e.args:
+            return Call(e.fn, args, e.type)
+        return e
+    return e
+
+
+def unwrap_casts(node: PlanNode) -> PlanNode:
+    srcs = node.sources
+    if srcs:
+        new = [unwrap_casts(s) for s in srcs]
+        if any(a is not b for a, b in zip(new, srcs)):
+            node = _replace_sources(node, new)
+    if isinstance(node, FilterNode):
+        return dc_replace(node, predicate=_unwrap_expr(node.predicate))
+    if isinstance(node, JoinNode) and node.filter is not None:
+        return dc_replace(node, filter=_unwrap_expr(node.filter))
+    return node
+
+
+# --------------------------------------------------------------------------
+# SingleDistinctAggregationToGroupBy (iterative/rule/
+# SingleDistinctAggregationToGroupBy.java): when EVERY aggregate is
+# DISTINCT over the same argument, dedup with an inner GROUP BY and run
+# plain aggregates on top — the two-level form is partial/final
+# combinable, which the distributed and remote schedulers exploit.
+# --------------------------------------------------------------------------
+
+def single_distinct_to_groupby(node: PlanNode) -> PlanNode:
+    from ..plan.nodes import Aggregate
+    srcs = node.sources
+    if srcs:
+        new = [single_distinct_to_groupby(s) for s in srcs]
+        if any(a is not b for a, b in zip(new, srcs)):
+            node = _replace_sources(node, new)
+    if not (isinstance(node, AggregationNode) and node.step == "SINGLE"
+            and node.group_id_symbol is None and node.aggregates):
+        return node
+    aggs = node.aggregates
+    if not all(a.distinct for a in aggs.values()):
+        return node
+    arg0 = next(iter(aggs.values())).argument
+    if arg0 is None or not all(
+            a.argument == arg0 and a.mask is None
+            and a.argument2 is None
+            and a.kind in ("count", "sum", "avg", "min", "max")
+            for a in aggs.values()):
+        return node
+    inner_keys = tuple(dict.fromkeys(node.group_keys + (arg0,)))
+    inner = AggregationNode(node.source, inner_keys, {}, "SINGLE")
+    outer = {s: Aggregate(a.kind, arg0, a.type, False, None)
+             for s, a in aggs.items()}
+    return AggregationNode(inner, node.group_keys, outer, "SINGLE")
+
+
+# --------------------------------------------------------------------------
+# CreatePartialTopN / partial limit (iterative/rule/CreatePartialTopN
+# .java): TopN/Limit over a UNION runs PARTIAL in every branch before
+# the merge — each branch keeps only its own top n rows.
+# --------------------------------------------------------------------------
+
+def _through_projects(node: PlanNode):
+    """(projects-from-top, innermost-source): the chain of row
+    -preserving projections under ``node`` (TopN/Limit commute with
+    them — PushLimitThroughProject)."""
+    projs = []
+    src = node
+    while isinstance(src, ProjectNode):
+        projs.append(src)
+        src = src.source
+    return projs, src
+
+
+def partial_topn_through_union(node: PlanNode) -> PlanNode:
+    from ..plan.nodes import SortKey
+    srcs = node.sources
+    if srcs:
+        new = [partial_topn_through_union(s) for s in srcs]
+        if any(a is not b for a, b in zip(new, srcs)):
+            node = _replace_sources(node, new)
+    if isinstance(node, TopNNode) and node.step == "SINGLE":
+        projs, u = _through_projects(node.source)
+        if isinstance(u, UnionNode):
+            # remap the sort keys through the (rename) projections
+            def remap(sym):
+                for p in projs:
+                    e = p.assignments.get(sym)
+                    if not isinstance(e, InputRef):
+                        return None
+                    sym = e.name
+                return sym
+            mapped = [remap(k.symbol) for k in node.keys]
+            if all(m is not None and all(m in smap
+                                         for smap in u.symbol_maps)
+                   for m in mapped):
+                kids = []
+                for child, smap in zip(u.children, u.symbol_maps):
+                    ckeys = tuple(
+                        SortKey(smap[m], k.ascending, k.nulls_first)
+                        for m, k in zip(mapped, node.keys))
+                    kids.append(TopNNode(child, node.count, ckeys,
+                                         "PARTIAL"))
+                rebuilt: PlanNode = dc_replace(u,
+                                               children=tuple(kids))
+                for p in reversed(projs):
+                    rebuilt = dc_replace(p, source=rebuilt)
+                return dc_replace(node, source=rebuilt, step="FINAL")
+    if isinstance(node, LimitNode) and not node.partial:
+        projs, u = _through_projects(node.source)
+        if isinstance(u, UnionNode):
+            kids = tuple(LimitNode(c, node.count, True)
+                         for c in u.children)
+            rebuilt = dc_replace(u, children=kids)
+            for p in reversed(projs):
+                rebuilt = dc_replace(p, source=rebuilt)
+            return dc_replace(node, source=rebuilt)
     return node
