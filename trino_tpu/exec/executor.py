@@ -77,19 +77,10 @@ _CHAIN_JIT_CACHE: Dict[tuple, object] = {}
 _CHAIN_JIT_DENY: set = set()
 
 
-_VOLATILE_FNS = {"now", "current_date", "current_time",
-                 "current_timestamp", "localtime", "localtimestamp",
-                 "random", "rand", "uuid"}
-
-
-def _expr_volatile(e) -> bool:
-    """True when the expression tree contains a volatile call — its
-    value must be re-evaluated per query, so the plan may NOT be served
-    from a cross-query program cache (the trace would freeze the first
-    query's clock/randomness)."""
-    from ..rex import Call as _C, walk as _walk
-    return any(isinstance(x, _C) and x.fn in _VOLATILE_FNS
-               for x in _walk(e))
+# volatility lives in rex (a property of expressions, shared with the
+# planner); these aliases keep the executor-local names working
+from ..rex import VOLATILE_FNS as _VOLATILE_FNS, \
+    expr_volatile as _expr_volatile
 
 
 def _node_fingerprint(nd) -> Optional[tuple]:

@@ -124,21 +124,33 @@ class RemoteScheduler:
                 and _splittable_agg(node):
             return self._cut_aggregation(node, frags)
         if isinstance(node, TopNNode) and _is_chain(node.source) \
-                and self._remotable(node.source) \
-                and node.step == "SINGLE":
+                and self._remotable(node.source):
             fid = len(frags)
-            part = dc_replace(node, step="PARTIAL")
-            frags.append(_Fragment(
-                fid, part,
-                lambda pre, n=node: dc_replace(n, source=pre,
-                                               step="FINAL")))
+            if node.step == "SINGLE":
+                part = dc_replace(node, step="PARTIAL")
+                frags.append(_Fragment(
+                    fid, part,
+                    lambda pre, n=node: dc_replace(n, source=pre,
+                                                   step="FINAL")))
+            elif node.step == "PARTIAL":
+                # an optimizer-created partial (CreatePartialTopN over
+                # a union branch) ships whole; its FINAL stays above
+                frags.append(_Fragment(fid, node, lambda pre: pre))
+            else:
+                frags.append(_Fragment(fid, node.source,
+                                       lambda pre, n=node: dc_replace(
+                                           n, source=pre)))
+                return _Placeholder(fid, node.source.output_schema())
             return _Placeholder(fid, node.output_schema())
         if isinstance(node, LimitNode) and _is_chain(node.source) \
-                and self._remotable(node.source) and not node.partial:
+                and self._remotable(node.source):
             fid = len(frags)
-            part = dc_replace(node, partial=True)
+            part = (node if node.partial
+                    else dc_replace(node, partial=True))
             frags.append(_Fragment(
-                fid, part, lambda pre, n=node: dc_replace(n, source=pre)))
+                fid, part,
+                (lambda pre: pre) if node.partial
+                else (lambda pre, n=node: dc_replace(n, source=pre))))
             return _Placeholder(fid, node.output_schema())
         if _is_chain(node) and not isinstance(node, TableScanNode) \
                 and self._remotable(node):
@@ -238,6 +250,11 @@ class RemoteScheduler:
         qid = uuid.uuid4().hex[:12]
         nparts = len(self.workers)
         session = self.session
+        # hash_partition_count caps the remote fan-out
+        # (SystemSessionProperties HASH_PARTITION_COUNT)
+        hpc = int(session.get("hash_partition_count"))
+        if hpc > 0:
+            nparts = min(nparts, hpc)
         results: Dict[int, List[Optional[Batch]]] = {
             f.fid: [None] * nparts for f in frags}
         errors: List[str] = []

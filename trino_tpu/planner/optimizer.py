@@ -41,14 +41,21 @@ def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
         force = "AUTOMATIC"
         reorder = "AUTOMATIC"
         pushdown = True
+        use_stats = True
         if session is not None:
             force = session.get("join_distribution_type") or "AUTOMATIC"
             reorder = (session.get("join_reordering_strategy")
                        or "AUTOMATIC")
             pushdown = bool(session.get("pushdown_into_scan"))
+            use_stats = bool(session.get("use_table_statistics"))
+        if not use_stats:
+            # optimizer.use-table-statistics=false: keep syntactic join
+            # order and runtime-heuristic distributions
+            reorder = "NONE"
         if str(reorder).upper() != "NONE":
             plan = reorder_joins(plan, catalogs)
-        plan = choose_join_sides(plan, catalogs, force)
+        if use_stats or str(force).upper() != "AUTOMATIC":
+            plan = choose_join_sides(plan, catalogs, force)
         if pushdown:
             plan = push_into_scan(plan, catalogs)
     plan = partial_topn_through_union(plan)
@@ -242,11 +249,11 @@ def _push(node: PlanNode, conjuncts: List[RowExpr]) -> PlanNode:
         # thin partitions instead of dropping them whole.
         # (iterative/rule/PushdownFilterIntoWindow.java /
         # PushdownFilterIntoRowNumber.java)
-        from ..exec.executor import _expr_volatile
         pkeys = set(node.partition_by)
 
         def pushable(c):
-            return rex.input_names(c) <= pkeys and not _expr_volatile(c)
+            return (rex.input_names(c) <= pkeys
+                    and not rex.expr_volatile(c))
         down = [c for c in conjuncts if pushable(c)]
         keep = [c for c in conjuncts if not pushable(c)]
         src = _push(node.source, down)

@@ -195,3 +195,17 @@ def split_conjuncts(e: Optional[RowExpr]):
     if isinstance(e, Call) and e.fn == "and":
         return split_conjuncts(e.args[0]) + split_conjuncts(e.args[1])
     return [e]
+
+
+# functions whose value must be re-evaluated per query/row — plans may
+# not cache programs containing them, and optimizer rewrites may not
+# duplicate or move them across row-set boundaries
+VOLATILE_FNS = frozenset({"now", "current_date", "current_time",
+                          "current_timestamp", "localtime",
+                          "localtimestamp", "random", "rand", "uuid"})
+
+
+def expr_volatile(e: RowExpr) -> bool:
+    """True when the expression tree contains a volatile call."""
+    return any(isinstance(x, Call) and x.fn in VOLATILE_FNS
+               for x in walk(e))

@@ -138,9 +138,20 @@ class QueryTracker:
             qid, sql, session.user, session.catalog, session.schema))
 
         def run_and_release():
+            timer = None
+            limit = int(session.get("query_max_run_time") or 0)
+            if limit > 0:
+                # QUERY_MAX_RUN_TIME enforcement: cooperative cancel
+                # after the wall-clock budget (the executor polls the
+                # cancel event between plan nodes)
+                timer = threading.Timer(limit, q.do_cancel)
+                timer.daemon = True
+                timer.start()
             try:
                 q.run(self._make_runner)
             finally:
+                if timer is not None:
+                    timer.cancel()
                 if q.group is not None and self.groups is not None:
                     self.groups.query_finished(q.group)
                 self.events.query_completed(QueryCompletedEvent(

@@ -43,17 +43,21 @@ def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
     return Batch(cols, hi - lo)
 
 
-def paginate(b: Batch, page_rows: int = PAGE_ROWS) -> List[bytes]:
+def paginate(b: Batch, page_rows: int = PAGE_ROWS,
+             codec: Optional[int] = None) -> List[bytes]:
     """Serialize a result batch as page frames (PagesSerde.serialize).
     Array results ship as a single frame: offsets reference the shared
     flat elements column, so slicing rows would re-ship the whole
-    elements buffer once per page."""
+    elements buffer once per page. ``codec`` None picks the default
+    (LZ4 when the native library is available); the
+    exchange_compression session property passes CODEC_STORE."""
     n = b.num_rows_host()
     if n == 0:
-        return [serialize_batch(_slice_batch(b, 0, 0))]
+        return [serialize_batch(_slice_batch(b, 0, 0), codec=codec)]
     if any(c.elements is not None for c in b.columns.values()):
-        return [serialize_batch(_slice_batch(b, 0, n))]
-    return [serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)))
+        return [serialize_batch(_slice_batch(b, 0, n), codec=codec)]
+    return [serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)),
+                            codec=codec)
             for lo in range(0, n, page_rows)]
 
 
@@ -91,7 +95,11 @@ class _Task:
             else:
                 runner = LocalQueryRunner(session=session)
                 res = runner.execute_batch(payload["sql"])
-            self.pages = paginate(res)
+            codec = None
+            if not bool(session.get("exchange_compression")):
+                from ..serde import CODEC_STORE
+                codec = CODEC_STORE
+            self.pages = paginate(res, codec=codec)
             self.state = "FINISHED"
         except Exception as e:   # noqa: BLE001
             self.state = "FAILED"

@@ -37,6 +37,19 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # connector pushdown (PushPredicateIntoTableScan /
     # PushLimitIntoTableScan); consulted by planner/optimizer.py
     "pushdown_into_scan": (bool, True),
+    # remote-task fan-out cap (SystemSessionProperties
+    # HASH_PARTITION_COUNT :58): 0 = one task per live worker
+    # (exec/remote.py RemoteScheduler)
+    "hash_partition_count": (int, 0),
+    # LZ4 page frames on the exchange (exchange.compression-enabled;
+    # server/task_worker.py paginate)
+    "exchange_compression": (bool, True),
+    # wall-clock limit in seconds, 0 = unlimited (QUERY_MAX_RUN_TIME
+    # :72; enforced by the coordinator's query tracker)
+    "query_max_run_time": (int, 0),
+    # cost-based join reorder/side decisions from connector statistics
+    # (optimizer.use-table-statistics; planner/optimizer.py)
+    "use_table_statistics": (bool, True),
 }
 
 
