@@ -122,3 +122,35 @@ def test_unknown_session_property_rejected():
         s.set("no_such_property", "1")
     with pytest.raises(KeyError, match="does not exist"):
         s.get("tpu_enabled")   # deleted inert flag stays deleted
+
+
+def test_query_detail_endpoint_and_ui_pages():
+    """Web UI v1: /v1/query/{id} carries state, per-node stats, and the
+    optimized plan tree; both UI pages serve (webapp QueryList/
+    QueryDetail analog)."""
+    import json as _json
+    import urllib.request
+    from trino_tpu.client import StatementClient
+    from trino_tpu.server.coordinator import Coordinator
+    coord = Coordinator().start()
+    try:
+        c = StatementClient(coord.base_uri, catalog="tpch",
+                            schema="tiny")
+        res = c.execute("SELECT o_orderpriority, count(*) FROM orders "
+                        "GROUP BY o_orderpriority")
+        qid = res.query_id
+        with urllib.request.urlopen(
+                f"{coord.base_uri}/v1/query/{qid}") as r:
+            d = _json.loads(r.read())
+        assert d["state"] == "FINISHED"
+        assert d["rows"] == 5
+        assert any("Aggregation" in line for line in d["plan"])
+        assert any("TableScan" in line for line in d["plan"])
+        stats = d.get("nodeStats") or []
+        assert stats and any(s["outputRows"] >= 5 for s in stats)
+        for page in ("/ui", f"/ui/query.html?{qid}"):
+            with urllib.request.urlopen(coord.base_uri + page) as r:
+                body = r.read().decode()
+            assert "<html" in body
+    finally:
+        coord.stop()

@@ -33,18 +33,27 @@ def test_unknown_property_rejected():
 
 
 def test_query_max_run_time_cancels_via_header():
+    sql = ("SELECT count(*) FROM lineitem a, lineitem b, "
+           "lineitem c WHERE a.l_orderkey = b.l_orderkey "
+           "AND b.l_orderkey = c.l_orderkey "
+           "AND a.l_comment < b.l_comment")
     coord = Coordinator().start()
     try:
+        # calibrate: a backend fast enough to finish this inside ~2s
+        # can't distinguish cancel-by-timer from completion — skip there
+        # (the suite pins CPU; this guards TRINO_TPU_TEST_PLATFORM runs)
+        t0 = time.time()
+        StatementClient(coord.base_uri, catalog="tpch",
+                        schema="tiny").execute(sql)
+        if time.time() - t0 < 2.0:
+            pytest.skip("backend finishes the probe query before the "
+                        "1s cancel timer could prove anything")
         c = StatementClient(
             coord.base_uri, catalog="tpch", schema="tiny",
             session_properties={"query_max_run_time": "1"})
         t0 = time.time()
         with pytest.raises(Exception, match="cancel|CANCEL"):
-            # a cross join big enough to outlive the 1s budget
-            c.execute("SELECT count(*) FROM lineitem a, lineitem b, "
-                      "lineitem c WHERE a.l_orderkey = b.l_orderkey "
-                      "AND b.l_orderkey = c.l_orderkey "
-                      "AND a.l_comment < b.l_comment")
+            c.execute(sql)
         assert time.time() - t0 < 60
     finally:
         coord.stop()
@@ -56,12 +65,15 @@ def test_exchange_compression_off_serves_store_frames():
     from trino_tpu.server.task_worker import (RemoteTaskClient,
                                               TaskWorkerServer)
     import urllib.request
+    from trino_tpu.serde import native_available
     srv = TaskWorkerServer().start()
     try:
         c = RemoteTaskClient(srv.base_uri)
         sql = "SELECT o_comment FROM orders LIMIT 2000"
+        # without the native library the default codec is already STORE
+        default_codec = CODEC_LZ4 if native_available() else CODEC_STORE
         for tid, props, want in (
-                ("t-lz4", {}, CODEC_LZ4),
+                ("t-lz4", {}, default_codec),
                 ("t-raw", {"exchange_compression": "false"},
                  CODEC_STORE)):
             c.submit(tid, sql, properties=props)

@@ -59,7 +59,11 @@ class LocalQueryRunner:
                  with_tpch: bool = True, distributed: bool = False,
                  n_devices: Optional[int] = None,
                  catalogs: Optional[CatalogManager] = None,
-                 mesh=None):
+                 mesh=None, collect_node_stats: bool = False):
+        # per-node wall/row stats on every query (OperatorStats is
+        # always-on in the reference; here opt-in because the stats
+        # fence adds a device sync per plan node)
+        self.collect_node_stats = collect_node_stats
         if catalogs is not None:
             self.catalogs = catalogs
         else:
@@ -142,7 +146,8 @@ class LocalQueryRunner:
     # ------------------------------------------------------------------
     def _dispatch(self, stmt: A.Statement, sql: str = "") -> QueryResult:
         if isinstance(stmt, A.QueryStatement):
-            return self._run_query(stmt)
+            return self._run_query(stmt,
+                                   collect_stats=self.collect_node_stats)
         if isinstance(stmt, A.CreateView):
             return self._create_view(stmt, sql)
         if isinstance(stmt, A.DropView):

@@ -57,6 +57,7 @@ class _Query:
     error: Optional[dict] = None
     result: Optional[QueryResult] = None
     created: float = field(default_factory=time.time)
+    ended: Optional[float] = None     # set at terminal transition
     source: str = ""
     group: Optional[object] = None   # assigned ResourceGroup
     _done: threading.Event = field(default_factory=threading.Event)
@@ -97,11 +98,15 @@ class _Query:
                                 .splitlines()[-5:]},
             }
         finally:
+            if self.ended is None:
+                self.ended = time.time()
             self._done.set()
 
     def do_cancel(self):
         self._cancel.set()
         if self._transition("CANCELED"):
+            if self.ended is None:
+                self.ended = time.time()
             self._done.set()
 
     def wait_done(self, timeout: float) -> bool:
@@ -243,9 +248,12 @@ class Coordinator:
                 from ..exec.remote import DistributedHostQueryRunner
                 return DistributedHostQueryRunner(
                     live, session=session, catalogs=self._catalogs)
+            # per-node wall/row stats feed the web UI's query detail
+            # (OperatorStats is always-on in the reference coordinator)
             return LocalQueryRunner(session=session,
                                     catalogs=self._catalogs,
-                                    mesh=self._proto.mesh)
+                                    mesh=self._proto.mesh,
+                                    collect_node_stats=True)
 
         events = EventListenerManager()
         for listener in (event_listeners or []):
@@ -324,14 +332,61 @@ class Coordinator:
                 "nodeId": self.node_id,
                 "uptime": f"{time.time() - self.started:.0f}s"}
 
+    def query_detail(self, q: _Query) -> dict:
+        """Query detail for /v1/query/{id} and the web UI: state,
+        timing, per-node stats, and the optimized plan tree (webapp
+        QueryDetail + LivePlan analog)."""
+        out = {
+            "queryId": q.query_id, "state": q.state, "query": q.sql,
+            "user": q.session.user, "source": q.source,
+            "created": time.strftime("%Y-%m-%d %H:%M:%S",
+                                     time.localtime(q.created)),
+            "elapsedTimeMillis": int(
+                ((q.ended or time.time()) - q.created) * 1000),
+            "error": q.error,
+        }
+        if q.result is not None:
+            out["rows"] = len(q.result.rows)
+            out["wallMillis"] = int(
+                (getattr(q.result, "wall_s", 0.0) or 0.0) * 1000)
+            stats = getattr(q.result, "stats", None)
+            if stats:
+                out["nodeStats"] = [
+                    {"node": s.name, "detail": s.detail,
+                     "wallMillis": round(s.wall_s * 1000, 2),
+                     "outputRows": s.output_rows} for s in stats]
+        plan = getattr(q, "_plan_lines", None)
+        if plan is None and q.state in ("FINISHED", "RUNNING"):
+            try:
+                from ..planner.logical import LogicalPlanner
+                from ..planner.optimizer import optimize
+                from ..plan.nodes import plan_tree_lines
+                from ..sql import ast as A
+                from ..sql.parser import parse_statement
+                stmt = parse_statement(q.sql)
+                if isinstance(stmt, A.QueryStatement):
+                    p = optimize(
+                        LogicalPlanner(self._catalogs,
+                                       q.session).plan(stmt),
+                        self._catalogs, q.session)
+                    plan = plan_tree_lines(p)
+                else:
+                    plan = []
+            except Exception:       # noqa: BLE001 — detail is best-effort
+                plan = []
+            q._plan_lines = plan
+        if plan:
+            out["plan"] = plan
+        return out
+
     def query_infos(self) -> list:
         return [{"queryId": q.query_id, "state": q.state,
                  "query": q.sql, "user": q.session.user,
                  "source": q.source,
                  "created": time.strftime(
                      "%Y-%m-%d %H:%M:%S", time.localtime(q.created)),
-                 "elapsedTimeMillis":
-                     int((time.time() - q.created) * 1000)}
+                 "elapsedTimeMillis": int(
+                     ((q.ended or time.time()) - q.created) * 1000)}
                 for q in self.tracker.all()]
 
     # ---- SystemProvider SPI (connectors/system.py) --------------------
@@ -389,12 +444,63 @@ async function refresh(){
  const t=document.getElementById('q');
  while(t.rows.length>1)t.deleteRow(1);
  for(const q of qs.reverse()){
-  const r=t.insertRow(); r.insertCell().textContent=q.queryId;
+  const r=t.insertRow(); const c=r.insertCell();
+  const a=document.createElement('a');
+  a.href='/ui/query.html?'+q.queryId; a.textContent=q.queryId;
+  c.appendChild(a);
   const s=r.insertCell(); s.textContent=q.state; s.className=q.state;
   r.insertCell().textContent=q.user||'';
   r.insertCell().textContent=(q.elapsedTimeMillis/1000).toFixed(1)+'s';
   r.insertCell().textContent=q.query.slice(0,120);}}
 refresh(); setInterval(refresh, 2000);
+</script></body></html>"""
+
+
+_UI_QUERY_PAGE = """<!doctype html>
+<html><head><title>query — trino-tpu</title><style>
+body{font-family:system-ui,sans-serif;margin:2em;background:#fafafa}
+h1{font-size:1.2em} pre{background:#fff;border:1px solid #ddd;
+padding:10px;overflow-x:auto;font-size:0.85em}
+table{border-collapse:collapse;margin:1em 0}
+td,th{border:1px solid #ddd;padding:5px 9px;font-size:0.85em;
+text-align:left} th{background:#f0f0f0}
+.FINISHED{color:#188038}.FAILED{color:#d93025}.RUNNING{color:#1a73e8}
+a{color:#1a73e8;text-decoration:none}
+</style></head><body>
+<a href="/ui">&larr; queries</a>
+<h1 id=title>query</h1><div id=meta></div>
+<h2>SQL</h2><pre id=sql></pre>
+<h2>Plan</h2><pre id=plan>(not available)</pre>
+<h2>Operator stats</h2>
+<table id=stats><tr><th>Node</th><th>Wall ms</th><th>Rows</th>
+<th>Detail</th></tr></table>
+<pre id=error style="color:#d93025;display:none"></pre>
+<script>
+const qid=location.search.slice(1);
+async function refresh(){
+ const q=await (await fetch('/v1/query/'+qid)).json();
+ document.getElementById('title').innerHTML=
+   q.queryId+' — <span class="'+q.state+'">'+q.state+'</span>';
+ document.getElementById('meta').textContent=
+   'user '+(q.user||'')+' · created '+(q.created||'')+' · elapsed '+
+   ((q.elapsedTimeMillis||0)/1000).toFixed(1)+'s'+
+   (q.rows!==undefined?' · '+q.rows+' rows':'');
+ document.getElementById('sql').textContent=q.query||'';
+ if(q.plan)document.getElementById('plan').textContent=
+   q.plan.join('\\n');
+ const t=document.getElementById('stats');
+ while(t.rows.length>1)t.deleteRow(1);
+ for(const s of (q.nodeStats||[])){
+  const r=t.insertRow(); r.insertCell().textContent=s.node;
+  r.insertCell().textContent=s.wallMillis;
+  r.insertCell().textContent=s.outputRows;
+  r.insertCell().textContent=(s.detail||'').slice(0,100);}
+ if(q.error){const e=document.getElementById('error');
+  e.style.display='block';
+  e.textContent=JSON.stringify(q.error,null,2);}
+ if(q.state==='RUNNING'||q.state==='QUEUED')
+   setTimeout(refresh,2000);}
+refresh();
 </script></body></html>"""
 
 
@@ -512,6 +618,9 @@ def _make_handler(co: Coordinator):
             if path == "/ui" or path == "/ui/":
                 self._send_html(_UI_PAGE)
                 return
+            if path == "/ui/query.html":
+                self._send_html(_UI_QUERY_PAGE)
+                return
             if path == "/v1/cluster":
                 qs = co.tracker.all()
                 self._send(200, {
@@ -533,9 +642,7 @@ def _make_handler(co: Coordinator):
                 if q is None:
                     self._send(404, {"error": "no such query"})
                     return
-                self._send(200, {"queryId": q.query_id,
-                                 "state": q.state, "query": q.sql,
-                                 "error": q.error})
+                self._send(200, co.query_detail(q))
                 return
             # /v1/statement/executing/{id}/{slug}/{token}
             if len(parts) == 6 and parts[:3] == ["v1", "statement",
