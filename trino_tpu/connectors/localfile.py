@@ -99,6 +99,113 @@ class LocalFileConnector(Connector):
                                 for k, v in json.loads(line).items()})
         return out
 
+    # --- data out (page-sink SPI: the reference's
+    # ConnectorPageSink writing ORC/Parquet files — lib/trino-orc
+    # OrcWriter / trino-parquet ParquetWriter; here formats/
+    # {orc,parquet}_writer.py) -------------------------------------------
+    write_format = "parquet"          # or "orc"
+    # types both writers round-trip exactly (smallint would silently
+    # widen to integer on rewrite — reject it up front)
+    _WRITABLE = ("bigint", "integer", "double", "boolean", "date")
+
+    def _check_writable(self, name: str, t: Type) -> None:
+        from ..types import is_string
+        if t.name not in self._WRITABLE and not is_string(t):
+            raise ValueError(
+                f"localfile writer: column '{name}' has type {t}, "
+                f"which the {self.write_format} writer cannot "
+                "round-trip exactly")
+
+    def _write(self, path: str, batch: Batch,
+               fmt: Optional[str] = None) -> None:
+        fmt = fmt or ("orc" if path.lower().endswith(".orc")
+                      else "parquet")
+        if fmt == "orc":
+            from ..formats.orc_writer import write_orc
+            write_orc(path, batch)
+        else:
+            from ..formats.parquet_writer import write_parquet
+            write_parquet(path, batch)
+
+    def _read_table(self, path: str) -> Batch:
+        """Whole-table read, shared by insert's rewrite and read_split
+        (one extension dispatch)."""
+        ext = os.path.splitext(path)[1].lower()
+        if ext == ".parquet":
+            from ..formats.parquet import read_parquet
+            return read_parquet(path)
+        if ext == ".orc":
+            from ..formats.orc import read_orc
+            return read_orc(path)
+        raise ValueError(f"writes to {ext} tables are not supported")
+
+    def _check_schema(self, schema: str) -> None:
+        if schema != "default":
+            raise KeyError(f"Schema '{schema}' does not exist")
+
+    def create_table(self, metadata: TableMetadata) -> None:
+        self._check_schema(metadata.schema)
+        if self._path_of(metadata.name) is not None:
+            raise ValueError(
+                f"Table '{metadata.name}' already exists")
+        for c in metadata.columns:
+            self._check_writable(c.name, c.type)
+        from ..columnar import empty_batch
+        path = os.path.join(self.root,
+                            f"{metadata.name}.{self.write_format}")
+        self._write(path, empty_batch(
+            {c.name: c.type for c in metadata.columns}))
+
+    def drop_table(self, schema: str, table: str) -> None:
+        self._check_schema(schema)
+        path = self._path_of(table)
+        if path is None:
+            raise KeyError(f"table {table} does not exist")
+        os.remove(path)
+
+    def insert(self, schema: str, table: str, batch: Batch) -> int:
+        """Append by rewrite under the connector's write lock (single
+        -file tables; the reference's page sink streams new files into
+        a directory instead). The incoming batch is aligned to the
+        table schema: missing columns fill with NULL, unknown columns
+        are rejected."""
+        self._check_schema(schema)
+        import threading
+        lock = self.__dict__.setdefault("_write_lock",
+                                        threading.Lock())
+        with lock:
+            path = self._path_of(table)
+            if path is None:
+                raise KeyError(f"table {table} does not exist")
+            tschema = self._schema_for(path)
+            extra = [c for c in batch.columns if c not in tschema]
+            if extra:
+                raise ValueError(
+                    f"INSERT columns {extra} do not exist in "
+                    f"'{table}'")
+            from ..columnar import column_from_pylist
+            n = batch.num_rows_host()
+            cols = {}
+            for name, t in tschema.items():
+                if name in batch.columns:
+                    cols[name] = batch.column(name)
+                else:
+                    # NULL fill at the batch's capacity so every
+                    # column shares one capacity bucket
+                    cols[name] = column_from_pylist(
+                        [None] * batch.capacity, t)
+            aligned = Batch(cols, n)
+            existing = self._read_table(path)
+            from ..exec.executor import device_concat
+            merged = (aligned if existing.num_rows_host() == 0
+                      else device_concat([existing, aligned]))
+            ext = os.path.splitext(path)[1].lower()
+            # the tmp suffix hides the real extension: pass the format
+            tmp = f"{path}.{os.getpid()}.tmp"
+            self._write(tmp, merged, fmt=ext.lstrip("."))
+            os.replace(tmp, path)
+            return n
+
     # --- splits ----------------------------------------------------------
     def get_splits(self, handle: TableHandle,
                    desired_parallelism: int = 1) -> List[Split]:

@@ -33,7 +33,7 @@ from ..columnar import Batch, Column, StringDictionary, pad_batch
 from ..config import capacity_for
 from ..types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL,
                      SMALLINT, TINYINT, DecimalType, TimestampType, Type,
-                     VarcharType, CharType, VARCHAR)
+                     VarcharType, CharType, VARCHAR, is_string)
 
 MAGIC = b"ORC"
 
@@ -597,7 +597,9 @@ def read_orc(path: str, columns: Optional[Sequence[str]] = None,
         if name not in want:
             continue
         sql = _sql_type(meta.types[ci])
-        if per_col_strs[name]:
+        if per_col_strs[name] or is_string(sql):
+            # string columns need a dictionary even with zero rows
+            # (Column.__post_init__ enforces it)
             dct, codes = StringDictionary.from_strings(
                 per_col_strs[name])
             valid = (np.asarray([s is not None

@@ -1,0 +1,218 @@
+"""From-scratch ORC writer (RLEv1 DIRECT, uncompressed, one stripe).
+
+Reference parity: lib/trino-orc's OrcWriter — the writer half of the
+L12 file-format libraries (round-4 verdict: readers only). Streams:
+PRESENT (bit MSB-first under byte RLE, only when nulls exist), DATA,
+LENGTH; protobuf footers mirror this package's reader (orc.py) and
+round-trip through pyarrow.orc (tests/test_orc_writer.py).
+
+Supported lanes: BIGINT/INTEGER (LONG/INT), DOUBLE, BOOLEAN, VARCHAR
+(STRING direct), DATE.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..columnar import Batch
+from ..types import Type, is_string
+
+from .orc import (K_BOOLEAN, K_DATE, K_DOUBLE, K_INT, K_LONG, K_STRING,
+                  K_STRUCT, MAGIC, S_DATA, S_LENGTH, S_PRESENT)
+
+_NONE_COMPRESSION = 0
+
+
+# --------------------------------------------------------------------------
+# protobuf writing (the pb_decode mirror)
+# --------------------------------------------------------------------------
+
+def _varint(out: bytearray, v: int):
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _uint(out: bytearray, tag: int, v: int):
+    _varint(out, (tag << 3) | 0)
+    _varint(out, v)
+
+
+def _blob(out: bytearray, tag: int, b: bytes):
+    _varint(out, (tag << 3) | 2)
+    _varint(out, len(b))
+    out += b
+
+
+# --------------------------------------------------------------------------
+# stream encoders (mirrors of orc.py's decoders)
+# --------------------------------------------------------------------------
+
+def _sleb(out: bytearray, v: int):
+    _varint(out, (v << 1) ^ (v >> 63) if v < 0 else v << 1)
+
+
+def rle_v1_encode(vals, signed: bool) -> bytes:
+    """Integer RLEv1 as literal groups of <=128 (always decodable;
+    run detection is an optimization the reader doesn't require)."""
+    out = bytearray()
+    vals = [int(v) for v in vals]
+    for lo in range(0, len(vals), 128):
+        group = vals[lo:lo + 128]
+        out.append(256 - len(group))
+        for v in group:
+            if signed:
+                _sleb(out, v)
+            else:
+                _varint(out, v)
+    return bytes(out)
+
+
+def byte_rle_encode(raw: bytes) -> bytes:
+    """Byte-level RLE as literal groups of <=128."""
+    out = bytearray()
+    for lo in range(0, len(raw), 128):
+        group = raw[lo:lo + 128]
+        out.append(256 - len(group))
+        out += group
+    return bytes(out)
+
+
+def _bool_stream(bits: np.ndarray) -> bytes:
+    return byte_rle_encode(np.packbits(bits.astype(bool)).tobytes())
+
+
+# --------------------------------------------------------------------------
+# writer
+# --------------------------------------------------------------------------
+
+def _orc_kind(t: Type) -> int:
+    name = t.name
+    if name in ("bigint", "integer", "smallint", "tinyint"):
+        return K_LONG if name == "bigint" else K_INT
+    if name in ("double", "real"):
+        return K_DOUBLE
+    if name == "boolean":
+        return K_BOOLEAN
+    if name == "date":
+        return K_DATE
+    if is_string(t):
+        return K_STRING
+    raise ValueError(f"orc writer: unsupported type {t}")
+
+
+def write_orc(path: str, batch: Batch,
+              columns: Optional[List[str]] = None) -> None:
+    """Write a Batch's live rows as a one-stripe ORC file."""
+    names = columns or list(batch.columns)
+    n = batch.num_rows_host()
+
+    # column id 0 is the root struct; children are 1..len(names)
+    col_streams: List[Tuple[int, int, bytes]] = []  # (kind, col, data)
+    kinds: List[int] = []
+    for ci, name in enumerate(names, start=1):
+        col = batch.column(name)
+        kind = _orc_kind(col.type)
+        kinds.append(kind)
+        data = np.asarray(col.data)[:n]
+        valid = (np.ones(n, dtype=bool) if col.valid is None
+                 else np.asarray(col.valid)[:n].astype(bool))
+        has_nulls = not valid.all()
+        if has_nulls:
+            col_streams.append((S_PRESENT, ci, _bool_stream(valid)))
+        if kind == K_BOOLEAN:
+            body = _bool_stream(data[valid].astype(bool))
+            col_streams.append((S_DATA, ci, body))
+        elif kind in (K_LONG, K_INT, K_DATE):
+            col_streams.append(
+                (S_DATA, ci,
+                 rle_v1_encode(data[valid].tolist(), signed=True)))
+        elif kind == K_DOUBLE:
+            col_streams.append(
+                (S_DATA, ci,
+                 np.ascontiguousarray(data[valid],
+                                      dtype="<f8").tobytes()))
+        else:   # K_STRING, direct encoding
+            if col.dictionary is not None:
+                vals = col.dictionary.values
+                dec = vals[np.clip(data.astype(np.int64), 0,
+                                   len(vals) - 1)]
+            else:
+                dec = data
+            blobs = [str(dec[i]).encode() for i in range(n)
+                     if valid[i]]
+            col_streams.append((S_DATA, ci, b"".join(blobs)))
+            col_streams.append(
+                (S_LENGTH, ci,
+                 rle_v1_encode([len(b) for b in blobs],
+                               signed=False)))
+
+    # ---- stripe ------------------------------------------------------
+    stripe_offset = len(MAGIC)
+    data_blob = bytearray()
+    sfoot = bytearray()
+    for kind, ci, body in col_streams:
+        data_blob += body
+        s = bytearray()
+        _uint(s, 1, kind)
+        _uint(s, 2, ci)
+        _uint(s, 3, len(body))
+        _blob(sfoot, 1, bytes(s))
+    for _ in range(len(names) + 1):      # root + children: DIRECT
+        e = bytearray()
+        _uint(e, 1, 0)
+        _blob(sfoot, 2, bytes(e))
+    sfoot_b = bytes(sfoot)
+
+    # ---- file footer -------------------------------------------------
+    footer = bytearray()
+    _uint(footer, 1, len(MAGIC))                      # headerLength
+    _uint(footer, 2,
+          len(MAGIC) + len(data_blob) + len(sfoot_b))  # contentLength
+    si = bytearray()
+    _uint(si, 1, stripe_offset)
+    _uint(si, 2, 0)                                   # indexLength
+    _uint(si, 3, len(data_blob))
+    _uint(si, 4, len(sfoot_b))
+    _uint(si, 5, n)
+    _blob(footer, 3, bytes(si))
+    root = bytearray()
+    _uint(root, 1, K_STRUCT)
+    for ci in range(1, len(names) + 1):
+        _uint(root, 2, ci)
+    for name in names:
+        _blob(root, 3, name.encode())
+    _blob(footer, 4, bytes(root))
+    for kind in kinds:
+        t = bytearray()
+        _uint(t, 1, kind)
+        _blob(footer, 4, bytes(t))
+    _uint(footer, 6, n)                               # numberOfRows
+    _uint(footer, 8, 0)                               # rowIndexStride
+    footer_b = bytes(footer)
+
+    ps = bytearray()
+    _uint(ps, 1, len(footer_b))                       # footerLength
+    _uint(ps, 2, _NONE_COMPRESSION)
+    _uint(ps, 3, 0)                                   # block size
+    _uint(ps, 4, 0)                                   # version 0.12
+    _uint(ps, 4, 12)
+    _uint(ps, 5, 0)                                   # metadataLength
+    _blob(ps, 8000, b"ORC")                           # PostScript.magic
+    ps_b = bytes(ps)
+    assert len(ps_b) < 256
+
+    with open(path, "wb") as f:
+        f.write(MAGIC)
+        f.write(bytes(data_blob))
+        f.write(sfoot_b)
+        f.write(footer_b)
+        f.write(ps_b)
+        f.write(bytes([len(ps_b)]))
