@@ -1,0 +1,65 @@
+"""Geospatial core (plugin/trino-geospatial GeoFunctions subset):
+point lanes, WKT in/out, vectorized polygon containment, haversine."""
+
+import pytest
+
+from trino_tpu.runner import LocalQueryRunner
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def test_point_accessors_and_distance(runner):
+    assert runner.execute(
+        "SELECT ST_X(ST_Point(1.5, 2.5)), ST_Y(ST_Point(1.5, 2.5))"
+    ).rows == [[1.5, 2.5]]
+    assert runner.execute(
+        "SELECT ST_Distance(ST_Point(0.0, 0.0), ST_Point(3.0, 4.0))"
+    ).rows == [[5.0]]
+
+
+def test_wkt_roundtrip(runner):
+    assert runner.execute(
+        "SELECT ST_AsText(ST_Point(1.0, -2.5))").rows == \
+        [["POINT (1 -2.5)"]]
+    assert runner.execute(
+        "SELECT ST_X(ST_GeometryFromText('POINT (7 8)')), "
+        "ST_Y(ST_GeometryFromText('POINT (7 8)'))").rows == [[7.0, 8.0]]
+
+
+def test_contains_vectorized_over_table(runner):
+    rows = runner.execute(
+        "SELECT x, ST_Contains(ST_GeometryFromText("
+        "'POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))'), "
+        "ST_Point(x, y)) FROM (VALUES (5.0, 5.0), (15.0, 5.0), "
+        "(-1.0, 2.0), (9.9, 9.9)) t(x, y) ORDER BY x").rows
+    assert [[float(x), c] for x, c in rows] == \
+        [[-1.0, False], [5.0, True], [9.9, True], [15.0, False]]
+
+
+def test_contains_multiple_polygons(runner):
+    # distinct WKT per row: each dictionary value parses once, masks
+    # apply per code
+    rows = runner.execute(
+        "SELECT ST_Contains(ST_GeometryFromText(p), ST_Point(1.0, 1.0)) "
+        "FROM (VALUES ('POLYGON ((0 0, 2 0, 2 2, 0 2, 0 0))'), "
+        "('POLYGON ((5 5, 6 5, 6 6, 5 6, 5 5))')) t(p)").rows
+    assert rows == [[True], [False]]
+
+
+def test_great_circle_distance(runner):
+    # the reference's documented example: BNA -> LAX ~2886.45 km
+    d = runner.execute(
+        "SELECT great_circle_distance(36.12, -86.67, 33.94, -118.40)"
+    ).rows[0][0]
+    assert d == pytest.approx(2886.45, abs=0.5)
+
+
+def test_point_in_where_clause(runner):
+    rows = runner.execute(
+        "SELECT count(*) FROM (VALUES (1.0, 1.0), (3.0, 3.0), "
+        "(9.0, 9.0)) t(x, y) WHERE ST_Distance(ST_Point(x, y), "
+        "ST_Point(0.0, 0.0)) < 5.0").rows
+    assert rows == [[2]]

@@ -438,6 +438,18 @@ class Batch:
                         col.append(q if not s
                                    else _dec.Decimal(q).scaleb(
                                        -s, _dec.Context(prec=80)))
+            elif t.name == "geometry":
+                # point lanes render as WKT; WKT-backed shapes pass
+                # their dictionary text through (ops/geo.py)
+                if c.dictionary is not None:
+                    vals = c.dictionary.values
+                    col = [(str(vals[int(data[i])])
+                            if valid[i] else None) for i in range(n)]
+                else:
+                    ys = np.asarray(c.data2)[:n]
+                    from .ops.geo import _fmt
+                    col = [(f"POINT ({_fmt(data[i])} {_fmt(ys[i])})"
+                            if valid[i] else None) for i in range(n)]
             elif t.name == "hyperloglog":
                 # rendered like the client renders varbinary: base64 of
                 # this engine's dense sketch framing (ops/hll.py)

@@ -29,7 +29,9 @@ class LocalFileConnector(Connector):
     name = "localfile"
 
     def __init__(self, root: str):
+        import threading
         self.root = root
+        self._write_lock = threading.Lock()
 
     # --- metadata --------------------------------------------------------
     def list_schemas(self) -> List[str]:
@@ -170,10 +172,7 @@ class LocalFileConnector(Connector):
         table schema: missing columns fill with NULL, unknown columns
         are rejected."""
         self._check_schema(schema)
-        import threading
-        lock = self.__dict__.setdefault("_write_lock",
-                                        threading.Lock())
-        with lock:
+        with self._write_lock:
             path = self._path_of(table)
             if path is None:
                 raise KeyError(f"table {table} does not exist")

@@ -1413,6 +1413,39 @@ def _date_add(e, batch):
 
 # ---- float predicates ----------------------------------------------------
 
+def _geo_call(which):
+    """Geospatial dispatch into ops/geo.py (vectorized point lanes).
+    Numeric arguments (coordinates) coerce to DOUBLE — a DECIMAL
+    literal's scaled-integer lane must not leak into geometry math."""
+    def h(e, batch):
+        from ..ops import geo
+        from ..types import GEOMETRY as _G, is_numeric as _isnum
+        args = [eval_expr(a, batch) for a in e.args]
+        args = [cast_column(a, DOUBLE)
+                if a.type is not _G and _isnum(a.type)
+                and a.type is not DOUBLE else a
+                for a in args]
+        try:
+            if which == "point":
+                return geo.point_column(*args)
+            if which == "x":
+                return geo.st_x(args[0])
+            if which == "y":
+                return geo.st_y(args[0])
+            if which == "distance":
+                return geo.st_distance(*args)
+            if which == "fromtext":
+                return geo.geometry_from_text(args[0])
+            if which == "astext":
+                return geo.as_text(args[0])
+            if which == "contains":
+                return geo.st_contains(*args)
+            return geo.great_circle_distance(*args)
+        except ValueError as ex:
+            raise EvalError(str(ex)) from ex
+    return h
+
+
 def _float_pred(fn):
     def h(e, batch):
         a = eval_expr(e.args[0], batch)
@@ -1711,6 +1744,12 @@ _DISPATCH: Dict[str, Callable] = {
     "greatest": _greatest_least("greatest"),
     "least": _greatest_least("least"),
     "is_nan": _float_pred(jnp.isnan),
+    "st_point": _geo_call("point"), "st_x": _geo_call("x"),
+    "st_y": _geo_call("y"), "st_distance": _geo_call("distance"),
+    "st_geometryfromtext": _geo_call("fromtext"),
+    "st_astext": _geo_call("astext"),
+    "st_contains": _geo_call("contains"),
+    "great_circle_distance": _geo_call("gcd"),
     "is_finite": _float_pred(jnp.isfinite),
     "is_infinite": _float_pred(jnp.isinf),
     "coalesce": _coalesce, "nullif": _nullif, "if": _if, "try": _try,

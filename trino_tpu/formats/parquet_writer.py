@@ -117,38 +117,29 @@ def _plain_encode(phys: int, values: list) -> bytes:
     raise AssertionError(phys)
 
 
+def _uleb(out: bytearray, v: int) -> None:
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
 def _def_levels(valid: np.ndarray) -> bytes:
     """RLE/bit-packed hybrid encoding of 1-bit definition levels,
     4-byte length prefixed (DataPageHeader definition_level_encoding
     RLE)."""
     n = len(valid)
+    body = bytearray()
     if valid.all():
-        # one RLE run of value 1
-        body = bytearray()
-        v = n << 1                 # RLE run header
-        while True:
-            b = v & 0x7F
-            v >>= 7
-            if v:
-                body.append(b | 0x80)
-            else:
-                body.append(b)
-                break
+        _uleb(body, n << 1)        # one RLE run of value 1
         body.append(1)
         return struct.pack("<I", len(body)) + bytes(body)
-    # bit-packed groups of 8 values
-    groups = (n + 7) // 8
-    header = (groups << 1) | 1
-    body = bytearray()
-    v = header
-    while True:
-        b = v & 0x7F
-        v >>= 7
-        if v:
-            body.append(b | 0x80)
-        else:
-            body.append(b)
-            break
+    groups = (n + 7) // 8          # bit-packed groups of 8 values
+    _uleb(body, (groups << 1) | 1)
     bits = np.zeros(groups * 8, dtype=bool)
     bits[:n] = valid
     body += np.packbits(bits, bitorder="little").tobytes()

@@ -14,7 +14,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 from .types import (BIGINT, BOOLEAN, DATE, DOUBLE, INTEGER, REAL, UNKNOWN,
                     VARCHAR, DecimalType, TimestampType, Type, VarcharType,
                     common_super_type, is_exact_numeric, is_integral,
-                    is_numeric, is_string)
+                    is_numeric, is_string, GEOMETRY)
 
 # --- aggregates -----------------------------------------------------------
 
@@ -258,6 +258,15 @@ _SCALARS: Dict[str, Callable[[str, Sequence[Type]], Type]] = {
     "is_infinite": lambda n, a: BOOLEAN,
     "greatest": _common, "least": _common,
     "width_bucket": _bigint_fn,
+    # geospatial core (plugin/trino-geospatial GeoFunctions; TPU-first
+    # point lanes — ops/geo.py)
+    "st_point": lambda n, a: GEOMETRY,
+    "st_geometryfromtext": lambda n, a: GEOMETRY,
+    "st_astext": lambda n, a: VARCHAR,
+    "st_x": lambda n, a: DOUBLE, "st_y": lambda n, a: DOUBLE,
+    "st_distance": lambda n, a: DOUBLE,
+    "st_contains": lambda n, a: BOOLEAN,
+    "great_circle_distance": _double_fn,
     # conditional (SpecialForm in the reference)
     "coalesce": _common,
     "nullif": lambda n, a: a[0],

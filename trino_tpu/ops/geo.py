@@ -1,0 +1,241 @@
+"""Geospatial kernels — the trino-geospatial toolkit core, TPU-first.
+
+Reference parity: plugin/trino-geospatial's GeoFunctions (ST_Point,
+ST_X/ST_Y, ST_Distance, ST_Contains, ST_GeometryFromText/ST_AsText,
+great_circle_distance). Redesign for the VPU: a POINT column is two
+float64 lanes (x, y) — distance and containment are branch-free array
+math over every row at once, instead of the reference's per-row ESRI
+geometry objects. Polygon operands arrive as WKT text (dictionary
+-coded), are parsed ONCE per distinct dictionary value host-side, and
+each distinct polygon's ray-casting mask computes vectorized over all
+points.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import Column, StringDictionary
+from ..types import BOOLEAN, DOUBLE, GEOMETRY, VARCHAR, is_string
+
+EARTH_RADIUS_KM = 6371.01
+
+
+def _merge_valid(*cols: Column) -> Optional[jax.Array]:
+    valid = None
+    for c in cols:
+        if c.valid is not None:
+            v = jnp.asarray(c.valid)
+            valid = v if valid is None else (valid & v)
+    return valid
+
+
+def point_column(x: Column, y: Column) -> Column:
+    return Column(GEOMETRY, jnp.asarray(x.data).astype(jnp.float64),
+                  _merge_valid(x, y),
+                  data2=jnp.asarray(y.data).astype(jnp.float64))
+
+
+def _require_points(c: Column, what: str):
+    if c.data2 is None or c.dictionary is not None:
+        raise ValueError(
+            f"{what} supports POINT geometries on this path "
+            "(non-point shapes are WKT-backed)")
+
+
+def _wkt_point_lanes(c: Column):
+    """(x, y, ok) lanes for a WKT-backed geometry column: each distinct
+    dictionary value parses once; rows referencing non-POINT values get
+    ok=False (NULL downstream) instead of poisoning the whole column —
+    a filtered column legitimately keeps dead dictionary values."""
+    vals = c.dictionary.values
+    xs = np.zeros(max(len(vals), 1))
+    ys = np.zeros(max(len(vals), 1))
+    ok = np.zeros(max(len(vals), 1), bool)
+    for i, v in enumerate(vals):
+        m = _POINT_RE.match(str(v))
+        if m is not None:
+            xs[i], ys[i], ok[i] = (float(m.group(1)),
+                                   float(m.group(2)), True)
+    codes = jnp.clip(jnp.asarray(c.data).astype(jnp.int32), 0,
+                     max(len(vals) - 1, 0))
+    return (jnp.take(jnp.asarray(xs), codes),
+            jnp.take(jnp.asarray(ys), codes),
+            jnp.take(jnp.asarray(ok), codes))
+
+
+def _xy(c: Column, what: str):
+    """(x, y, valid) from either representation."""
+    if c.dictionary is not None:
+        x, y, ok = _wkt_point_lanes(c)
+        valid = ok if c.valid is None else (jnp.asarray(c.valid) & ok)
+        return x, y, valid
+    _require_points(c, what)
+    return (jnp.asarray(c.data), jnp.asarray(c.data2),
+            None if c.valid is None else jnp.asarray(c.valid))
+
+
+def st_x(c: Column) -> Column:
+    x, _y, valid = _xy(c, "ST_X")
+    return Column(DOUBLE, x, valid)
+
+
+def st_y(c: Column) -> Column:
+    _x, y, valid = _xy(c, "ST_Y")
+    return Column(DOUBLE, y, valid)
+
+
+def st_distance(a: Column, b: Column) -> Column:
+    """Euclidean point distance (the reference's planar ST_Distance)."""
+    ax, ay, av = _xy(a, "ST_Distance")
+    bx, by, bv = _xy(b, "ST_Distance")
+    dx = ax - bx
+    dy = ay - by
+    valid = av if bv is None else (bv if av is None else av & bv)
+    return Column(DOUBLE, jnp.sqrt(dx * dx + dy * dy), valid)
+
+
+def great_circle_distance(lat1: Column, lon1: Column, lat2: Column,
+                          lon2: Column) -> Column:
+    """Haversine distance in km (reference GeoFunctions
+    great_circle_distance)."""
+    lanes = [jnp.radians(jnp.asarray(c.data).astype(jnp.float64))
+             for c in (lat1, lon1, lat2, lon2)]
+    p1, l1, p2, l2 = lanes
+    dphi = p2 - p1
+    dlmb = l2 - l1
+    h = (jnp.sin(dphi / 2) ** 2
+         + jnp.cos(p1) * jnp.cos(p2) * jnp.sin(dlmb / 2) ** 2)
+    d = 2 * EARTH_RADIUS_KM * jnp.arcsin(jnp.sqrt(jnp.clip(h, 0.0,
+                                                           1.0)))
+    return Column(DOUBLE, d, _merge_valid(lat1, lon1, lat2, lon2))
+
+
+# --------------------------------------------------------------------------
+# WKT in/out
+# --------------------------------------------------------------------------
+
+_POINT_RE = re.compile(
+    r"\s*POINT\s*\(\s*([-+0-9.eE]+)\s+([-+0-9.eE]+)\s*\)\s*\Z",
+    re.IGNORECASE)
+
+
+def geometry_from_text(c: Column) -> Column:
+    """WKT varchar -> geometry. POINT text becomes (x, y) lanes;
+    any other shape stays dictionary-coded WKT (parsed lazily by the
+    consuming kernel)."""
+    if not is_string(c.type) or c.dictionary is None:
+        raise ValueError("ST_GeometryFromText expects varchar WKT")
+    vals = c.dictionary.values
+    xs, ys, all_points = [], [], True
+    for v in vals:
+        m = _POINT_RE.match(str(v))
+        if m is None:
+            all_points = False
+            break
+        xs.append(float(m.group(1)))
+        ys.append(float(m.group(2)))
+    if all_points and len(vals):
+        codes = jnp.asarray(c.data).astype(jnp.int32)
+        x = jnp.take(jnp.asarray(np.asarray(xs)), codes, mode="clip")
+        y = jnp.take(jnp.asarray(np.asarray(ys)), codes, mode="clip")
+        return Column(GEOMETRY, x, c.valid, data2=y)
+    return Column(GEOMETRY, jnp.asarray(c.data), c.valid, c.dictionary)
+
+
+def as_text(c: Column) -> Column:
+    if c.dictionary is not None:      # WKT-backed shape: passthrough
+        return Column(VARCHAR, jnp.asarray(c.data), c.valid,
+                      c.dictionary)
+    _require_points(c, "ST_AsText")
+    xs = np.asarray(c.data)
+    ys = np.asarray(c.data2)
+    out = [f"POINT ({_fmt(xs[i])} {_fmt(ys[i])})"
+           for i in range(len(xs))]
+    d, codes = StringDictionary.from_strings(out)
+    return Column(VARCHAR, jnp.asarray(codes), c.valid, d)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+# --------------------------------------------------------------------------
+# polygon containment
+# --------------------------------------------------------------------------
+
+_POLY_RE = re.compile(
+    r"\s*POLYGON\s*\(\s*\((?P<ring>[^)]*)\)", re.IGNORECASE)
+
+
+def _parse_polygon(wkt: str) -> Tuple[np.ndarray, np.ndarray]:
+    m = _POLY_RE.match(wkt)
+    if m is None:
+        raise ValueError(f"unsupported geometry for ST_Contains: "
+                         f"{wkt[:40]!r}")
+    pts = []
+    for pair in m.group("ring").split(","):
+        xy = pair.split()
+        pts.append((float(xy[0]), float(xy[1])))
+    arr = np.asarray(pts, dtype=np.float64)
+    return arr[:, 0], arr[:, 1]
+
+
+def _ray_cast(px: jax.Array, py: jax.Array, xs: np.ndarray,
+              ys: np.ndarray) -> jax.Array:
+    """Vectorized even-odd rule: one pass per polygon edge, all rows
+    at once (the VPU-friendly inversion of per-row point-in-polygon)."""
+    inside = jnp.zeros(px.shape, dtype=bool)
+    n = len(xs)
+    for i in range(n - 1):
+        xi, yi, xj, yj = xs[i], ys[i], xs[i + 1], ys[i + 1]
+        if yi == yj:
+            continue
+        crosses = ((yi > py) != (yj > py)) & (
+            px < (xj - xi) * (py - yi) / (yj - yi) + xi)
+        inside = inside ^ crosses
+    return inside
+
+
+def st_contains(shape: Column, points: Column) -> Column:
+    """Polygon-contains-point, polygons dictionary-coded WKT: each
+    DISTINCT polygon parses once and masks every row vectorized; rows
+    pick their polygon's verdict by dictionary code."""
+    _require_points(points, "ST_Contains (point argument)")
+    if shape.dictionary is None:
+        raise ValueError(
+            "ST_Contains expects a WKT-backed shape (POLYGON) as the "
+            "first argument")
+    px = jnp.asarray(points.data)
+    py = jnp.asarray(points.data2)
+    masks = []
+    parse_ok = []
+    for wkt in shape.dictionary.values:
+        # an unparseable dictionary value NULLs only the rows that
+        # reference it — a filter legitimately strands dead values in
+        # the dictionary
+        try:
+            xs, ys = _parse_polygon(str(wkt))
+        except ValueError:
+            masks.append(jnp.zeros(px.shape, bool))
+            parse_ok.append(False)
+            continue
+        masks.append(_ray_cast(px, py, xs, ys))
+        parse_ok.append(True)
+    stacked = jnp.stack(masks) if masks else jnp.zeros(
+        (1,) + px.shape, bool)
+    codes = jnp.clip(jnp.asarray(shape.data).astype(jnp.int32), 0,
+                     max(len(masks) - 1, 0))
+    data = jnp.take_along_axis(stacked, codes[None, :], axis=0)[0]
+    valid = _merge_valid(shape, points)
+    if not all(parse_ok):
+        ok = jnp.take(jnp.asarray(np.asarray(parse_ok, bool)), codes,
+                      mode="clip")
+        valid = ok if valid is None else valid & ok
+    return Column(BOOLEAN, data, valid)

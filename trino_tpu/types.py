@@ -30,7 +30,8 @@ __all__ = [
     "DOUBLE", "VARCHAR", "VARBINARY", "DATE", "UNKNOWN", "DecimalType",
     "VarcharType", "CharType", "TimestampType", "TimeType", "ArrayType",
     "MapType", "RowType", "HyperLogLogType", "HYPER_LOG_LOG",
-    "TDigestType", "T_DIGEST", "QDigestType",
+    "TDigestType", "T_DIGEST", "QDigestType", "GeometryType",
+    "GEOMETRY",
     "IntervalDayTime", "IntervalYearMonth", "parse_type", "common_super_type",
     "is_numeric", "is_integral", "is_exact_numeric", "is_string",
 ]
@@ -102,6 +103,20 @@ class HyperLogLogType(Type):
 
 
 HYPER_LOG_LOG = HyperLogLogType()
+
+
+@dataclass(frozen=True)
+class GeometryType(Type):
+    """GEOMETRY (reference: trino-geospatial's GeometryType over ESRI
+    shapes). TPU-first representation: POINT geometries are two float64
+    lanes (x in ``data``, y in ``data2``) — ST_Distance/ST_Contains are
+    pure VPU math; non-point shapes ride dictionary-coded WKT text."""
+
+    def __init__(self):
+        object.__setattr__(self, "name", "geometry")
+
+
+GEOMETRY = GeometryType()
 
 
 @dataclass(frozen=True)
@@ -483,6 +498,7 @@ _SIMPLE["string"] = VARCHAR
 _SIMPLE["varchar"] = VARCHAR
 _SIMPLE["timestamp"] = TimestampType(3)
 _SIMPLE["hyperloglog"] = HYPER_LOG_LOG
+_SIMPLE["geometry"] = GEOMETRY
 _SIMPLE["tdigest"] = T_DIGEST
 _SIMPLE["p4hyperloglog"] = HYPER_LOG_LOG
 
