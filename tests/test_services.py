@@ -180,3 +180,64 @@ def test_grant_enforcement():
     admin.execute("DENY SELECT ON memory.default.sec_t TO alice")
     with pytest.raises((QueryError, Exception), match="Access Denied"):
         alice.execute("SELECT * FROM memory.default.sec_t")
+
+
+def test_jwt_bearer_authentication():
+    """JWT HS256 end to end: valid token runs a query as the token's
+    principal; expired/forged tokens get 401; impersonation mismatch
+    gets 403 (server/security/jwt/JwtAuthenticator.java analog)."""
+    import json as _json
+    import time as _time
+    import urllib.error
+    import urllib.request
+    from trino_tpu.security import JwtAuthenticator
+    from trino_tpu.server.coordinator import Coordinator
+
+    auth = JwtAuthenticator(b"secret-key", required_issuer="tt")
+    coord = Coordinator(authenticator=auth).start()
+
+    def post(token, extra=None):
+        req = urllib.request.Request(
+            coord.base_uri + "/v1/statement",
+            data=b"SELECT 1",
+            headers={"Authorization": f"Bearer {token}",
+                     **(extra or {})}, method="POST")
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, _json.loads(r.read())
+
+    try:
+        good = auth.sign({"sub": "alice", "iss": "tt",
+                          "exp": _time.time() + 60})
+        status, payload = post(good)
+        assert status == 200 and "error" not in payload
+
+        expired = auth.sign({"sub": "alice", "iss": "tt",
+                             "exp": _time.time() - 5})
+        try:
+            post(expired)
+            assert False, "expired token accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+
+        forged = good[:-4] + "AAAA"
+        try:
+            post(forged)
+            assert False, "forged token accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+
+        wrong_iss = auth.sign({"sub": "alice", "iss": "other",
+                               "exp": _time.time() + 60})
+        try:
+            post(wrong_iss)
+            assert False, "wrong issuer accepted"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+
+        try:
+            post(good, {"X-Trino-User": "mallory"})
+            assert False, "impersonation allowed"
+        except urllib.error.HTTPError as e:
+            assert e.code == 403
+    finally:
+        coord.stop()

@@ -214,3 +214,85 @@ class GrantBasedAccessControl(AccessControl):
             raise AccessDeniedError(
                 f"Cannot drop table {catalog}.{schema}.{table} "
                 f"as user {user}")
+
+
+class TokenAuthenticator:
+    """Bearer-token authentication (spi: the Authenticator family —
+    server/security/jwt/JwtAuthenticator.java).
+    ``authenticate_token(token)`` returns the principal or None."""
+
+    def authenticate_token(self, token: str):
+        raise NotImplementedError
+
+
+class JwtAuthenticator(TokenAuthenticator):
+    """HS256 JWT validation on a shared secret
+    (http-server.authentication.jwt with a symmetric key):
+    signature check, ``exp`` enforcement, principal from the
+    ``principal_field`` claim (default ``sub``)."""
+
+    def __init__(self, secret: bytes, principal_field: str = "sub",
+                 required_audience: Optional[str] = None,
+                 required_issuer: Optional[str] = None):
+        self.secret = secret
+        self.principal_field = principal_field
+        self.required_audience = required_audience
+        self.required_issuer = required_issuer
+
+    @staticmethod
+    def _b64url_decode(part: str) -> bytes:
+        import base64
+        pad = "=" * (-len(part) % 4)
+        return base64.urlsafe_b64decode(part + pad)
+
+    @staticmethod
+    def _b64url_encode(raw: bytes) -> str:
+        import base64
+        return base64.urlsafe_b64encode(raw).rstrip(b"=").decode()
+
+    def sign(self, claims: dict) -> str:
+        """Mint a token (test harness / internal-node auth helper —
+        InternalAuthenticationManager mints its own JWTs the same
+        way)."""
+        import json as _json
+        header = self._b64url_encode(
+            _json.dumps({"alg": "HS256", "typ": "JWT"}).encode())
+        body = self._b64url_encode(_json.dumps(claims).encode())
+        signing_input = f"{header}.{body}".encode()
+        sig = hmac.new(self.secret, signing_input,
+                       hashlib.sha256).digest()
+        return f"{header}.{body}.{self._b64url_encode(sig)}"
+
+    def authenticate_token(self, token: str):
+        import json as _json
+        import time as _time
+        try:
+            header_b64, body_b64, sig_b64 = token.split(".")
+            header = _json.loads(self._b64url_decode(header_b64))
+            if header.get("alg") != "HS256":
+                return None          # alg confusion is an instant reject
+            signing_input = f"{header_b64}.{body_b64}".encode()
+            want = hmac.new(self.secret, signing_input,
+                            hashlib.sha256).digest()
+            if not hmac.compare_digest(want,
+                                       self._b64url_decode(sig_b64)):
+                return None
+            claims = _json.loads(self._b64url_decode(body_b64))
+            if not isinstance(claims, dict):
+                return None
+            exp = claims.get("exp")
+            if exp is not None and _time.time() > float(exp):
+                return None
+            if self.required_issuer is not None \
+                    and claims.get("iss") != self.required_issuer:
+                return None
+            if self.required_audience is not None:
+                aud = claims.get("aud")
+                auds = aud if isinstance(aud, list) else [aud]
+                if self.required_audience not in auds:
+                    return None
+            principal = claims.get(self.principal_field)
+            return (principal if isinstance(principal, str)
+                    else None)
+        except Exception:    # malformed token or odd claim shapes
+            return None

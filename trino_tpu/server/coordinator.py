@@ -527,6 +527,26 @@ def _make_handler(co: Coordinator):
             self.end_headers()
             self.wfile.write(raw)
 
+        def _auth_reject(self, code: int, payload: dict,
+                         www: Optional[str] = None) -> bool:
+            """Reject the request before the body is consumed: the
+            connection must close (keep-alive would parse the unread
+            POST body as the next request)."""
+            self.close_connection = True
+            if www is not None:
+                # header must precede _send's end_headers: replicate
+                # _send with the extra header
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("WWW-Authenticate", www)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(code, payload)
+            return False
+
         def _authenticate(self) -> bool:
             """HTTP Basic auth against the configured password
             authenticator (server/security/PasswordAuthenticator
@@ -540,6 +560,20 @@ def _make_handler(co: Coordinator):
                 return True
             import base64
             header = self.headers.get("Authorization", "")
+            if header.startswith("Bearer ") and hasattr(
+                    co.authenticator, "authenticate_token"):
+                # JWT / bearer tokens (server/security/jwt/
+                # JwtAuthenticator.java)
+                principal = co.authenticator.authenticate_token(
+                    header[7:].strip())
+                if principal is not None:
+                    claimed = self.headers.get("X-Trino-User")
+                    if claimed and claimed != principal:
+                        return self._auth_reject(403, {
+                            "error": f"Access Denied: User {principal}"
+                            f" cannot impersonate {claimed}"})
+                    self.principal = principal
+                    return True
             if header.startswith("Basic "):
                 try:
                     raw = base64.b64decode(header[6:]).decode()
@@ -547,31 +581,16 @@ def _make_handler(co: Coordinator):
                     if co.authenticator.authenticate(user, pw):
                         claimed = self.headers.get("X-Trino-User")
                         if claimed and claimed != user:
-                            body = json.dumps({
+                            return self._auth_reject(403, {
                                 "error": f"Access Denied: User {user} "
-                                f"cannot impersonate {claimed}"
-                            }).encode()
-                            self.send_response(403)
-                            self.send_header("Content-Type",
-                                             "application/json")
-                            self.send_header("Content-Length",
-                                             str(len(body)))
-                            self.end_headers()
-                            self.wfile.write(body)
-                            return False
+                                f"cannot impersonate {claimed}"})
                         self.principal = user
                         return True
                 except Exception:
                     pass
-            body = json.dumps({"error": "Unauthorized"}).encode()
-            self.send_response(401)
-            self.send_header("WWW-Authenticate",
-                             'Basic realm="trino-tpu"')
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
-            return False
+            return self._auth_reject(
+                401, {"error": "Unauthorized"},
+                www='Basic realm="trino-tpu"')
 
         def do_POST(self):
             if not self._authenticate():
