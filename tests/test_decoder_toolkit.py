@@ -1,0 +1,67 @@
+"""record-decoder + plugin/test toolkit (lib/trino-record-decoder,
+lib/trino-plugin-toolkit + testing QueryAssertions analogs)."""
+
+import pytest
+
+from trino_tpu.formats.record_decoder import (DecoderField,
+                                              create_decoder)
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.testing import (TestingConnector, assert_query,
+                               assert_query_fails)
+from trino_tpu.types import BIGINT, BOOLEAN, DOUBLE, VARCHAR
+
+
+def test_json_decoder_paths_and_nulls():
+    dec = create_decoder("json", [
+        DecoderField("id", BIGINT, "user.id"),
+        DecoderField("name", VARCHAR, "user.name"),
+        DecoderField("score", DOUBLE, "score"),
+        DecoderField("ok", BOOLEAN, "flags.ok"),
+    ])
+    msgs = [
+        b'{"user": {"id": 1, "name": "a"}, "score": 1.5,'
+        b' "flags": {"ok": true}}',
+        b'{"user": {"id": 2}, "score": "2.5"}',
+        b'not json at all',
+    ]
+    assert dec.decode(msgs).to_pylist() == [
+        [1, "a", 1.5, True],
+        [2, None, 2.5, None],
+        [None, None, None, None],
+    ]
+
+
+def test_csv_decoder_indices():
+    dec = create_decoder("csv", [
+        DecoderField("a", BIGINT, "0"),
+        DecoderField("b", VARCHAR, "2"),
+    ])
+    assert dec.decode([b"1,x,alpha", b'2,y,"q,uoted"', b"3"]) \
+        .to_pylist() == [[1, "alpha"], [2, "q,uoted"], [3, None]]
+
+
+def test_raw_decoder_and_unknown_kind():
+    dec = create_decoder("raw", [DecoderField("msg", VARCHAR)])
+    assert dec.decode([b"hello", b"world"]).to_pylist() == \
+        [["hello"], ["world"]]
+    with pytest.raises(ValueError, match="unknown decoder"):
+        create_decoder("avro", [])
+
+
+def test_testing_connector_and_assertions():
+    conn = TestingConnector()
+    conn.add_table("people", {"id": BIGINT, "city": VARCHAR},
+                   [{"id": 1, "city": "oslo"},
+                    {"id": 2, "city": "lima"},
+                    {"id": 3, "city": None}])
+    r = LocalQueryRunner()
+    r.catalogs.register("t", conn)
+    assert_query(r, "SELECT city, count(*) FROM t.default.people "
+                    "GROUP BY city",
+                 [["oslo", 1], ["lima", 1], [None, 1]])
+    assert_query(r, "SELECT id FROM t.default.people ORDER BY id DESC",
+                 [[3], [2], [1]], ordered=True)
+    assert_query_fails(r, "SELECT nope FROM t.default.people",
+                       "cannot be resolved")
+    with pytest.raises(AssertionError):
+        assert_query(r, "SELECT 1", [[2]])

@@ -283,6 +283,9 @@ class JwtAuthenticator(TokenAuthenticator):
             exp = claims.get("exp")
             if exp is not None and _time.time() > float(exp):
                 return None
+            nbf = claims.get("nbf")
+            if nbf is not None and _time.time() < float(nbf):
+                return None          # not yet valid (RFC 7519 4.1.5)
             if self.required_issuer is not None \
                     and claims.get("iss") != self.required_issuer:
                 return None

@@ -511,9 +511,11 @@ def _make_handler(co: Coordinator):
         def log_message(self, *args):   # quiet
             pass
 
-        def _send(self, code: int, payload):
+        def _send(self, code: int, payload, headers=None):
             body = json.dumps(payload).encode()
             self.send_response(code)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -533,18 +535,9 @@ def _make_handler(co: Coordinator):
             connection must close (keep-alive would parse the unread
             POST body as the next request)."""
             self.close_connection = True
-            if www is not None:
-                # header must precede _send's end_headers: replicate
-                # _send with the extra header
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("WWW-Authenticate", www)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-            else:
-                self._send(code, payload)
+            self._send(code, payload,
+                       headers={"WWW-Authenticate": www} if www
+                       else None)
             return False
 
         def _authenticate(self) -> bool:
