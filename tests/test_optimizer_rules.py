@@ -154,3 +154,28 @@ def test_filter_pushes_into_window_partition(runner):
         "PARTITION BY o_custkey ORDER BY o_totalprice) r FROM orders "
         "ORDER BY r").rows if r[0] == 370]
     assert got == exp
+
+
+def test_matching_engine():
+    """lib/trino-matching analog: typed patterns with property checks,
+    source sub-patterns, and captures."""
+    from trino_tpu.matching import Capture, Pattern
+    from trino_tpu.plan.nodes import LimitNode, TopNNode, UnionNode
+
+    union_cap = Capture("union")
+    pat = (Pattern.type_of(TopNNode)
+           .with_prop("step", "SINGLE")
+           .with_source(Pattern.type_of(UnionNode)
+                        .capture_as(union_cap)))
+    u = UnionNode((), {}, ())
+    topn = TopNNode(u, 5, (), "SINGLE")
+    m = pat.match(topn)
+    assert m and m[union_cap] is u
+    assert pat.match(TopNNode(u, 5, (), "FINAL")) is None
+    assert pat.match(LimitNode(u, 5)) is None
+    # predicate checks + shared-pattern immutability
+    base = Pattern.type_of(LimitNode)
+    small = base.matching("count", lambda c: c is not None and c < 10)
+    assert small.match(LimitNode(u, 5))
+    assert small.match(LimitNode(u, 50)) is None
+    assert base.match(LimitNode(u, 50))   # base unaffected

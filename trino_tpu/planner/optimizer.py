@@ -708,6 +708,15 @@ def _through_projects(node: PlanNode):
     return projs, src
 
 
+# rule shapes, declared with the matching engine (the reference's
+# Rule.pattern() contract — lib/trino-matching; CreatePartialTopN
+# declares topN().with(step SINGLE) the same way)
+from ..matching import Pattern as _Pat
+
+_TOPN_SINGLE = _Pat.type_of(TopNNode).with_prop("step", "SINGLE")
+_LIMIT_FULL = _Pat.type_of(LimitNode).with_prop("partial", False)
+
+
 def partial_topn_through_union(node: PlanNode) -> PlanNode:
     from ..plan.nodes import SortKey
     srcs = node.sources
@@ -715,7 +724,7 @@ def partial_topn_through_union(node: PlanNode) -> PlanNode:
         new = [partial_topn_through_union(s) for s in srcs]
         if any(a is not b for a, b in zip(new, srcs)):
             node = _replace_sources(node, new)
-    if isinstance(node, TopNNode) and node.step == "SINGLE":
+    if _TOPN_SINGLE.match(node):
         projs, u = _through_projects(node.source)
         if isinstance(u, UnionNode):
             # remap the sort keys through the (rename) projections
@@ -742,7 +751,7 @@ def partial_topn_through_union(node: PlanNode) -> PlanNode:
                 for p in reversed(projs):
                     rebuilt = dc_replace(p, source=rebuilt)
                 return dc_replace(node, source=rebuilt, step="FINAL")
-    if isinstance(node, LimitNode) and not node.partial:
+    if _LIMIT_FULL.match(node):
         projs, u = _through_projects(node.source)
         if isinstance(u, UnionNode):
             kids = tuple(LimitNode(c, node.count, True)
