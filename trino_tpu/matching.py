@@ -6,8 +6,9 @@ machinery under every iterative-optimizer rule
 IterativeOptimizer matches it before invoking apply). The optimizer
 here is whole-tree rewrites, so this engine serves the same role at
 the call sites that benefit from declarative shape tests
-(planner/optimizer.py's partial-TopN rule declares its TopN-over-
-Union shape with it).
+(planner/optimizer.py's partial-TopN and partial-limit rules declare
+their trigger shapes with it; the union half of those rules stays
+imperative because the projection-chain walk has no pattern form).
 
 Usage:
     CAP = Capture("union")
@@ -108,7 +109,10 @@ class Pattern:
         if self._cls is not None and not isinstance(node, self._cls):
             return False
         for name, pred in self._checks:
-            if not pred(getattr(node, name, None)):
+            # strict getattr: a typo'd property must raise, not make
+            # the pattern silently never match (a disabled optimizer
+            # rule with no failing test is the worst outcome)
+            if not pred(getattr(node, name)):
                 return False
         for attr, sub in self._sources.items():
             child = getattr(node, attr, None)

@@ -28,6 +28,7 @@ from ..plan.nodes import (AggregationNode, AssignUniqueIdNode,
                           PlanNode, ProjectNode, SampleNode, SemiJoinNode,
                           SetOpNode, SortNode, TableScanNode, TopNNode,
                           UnionNode, ValuesNode, WindowNode)
+from ..matching import Pattern as _Pat
 from ..planner.logical import SemiJoinMultiNode
 from ..rex import Call, Const, InputRef, RowExpr, TRUE
 
@@ -711,8 +712,6 @@ def _through_projects(node: PlanNode):
 # rule shapes, declared with the matching engine (the reference's
 # Rule.pattern() contract — lib/trino-matching; CreatePartialTopN
 # declares topN().with(step SINGLE) the same way)
-from ..matching import Pattern as _Pat
-
 _TOPN_SINGLE = _Pat.type_of(TopNNode).with_prop("step", "SINGLE")
 _LIMIT_FULL = _Pat.type_of(LimitNode).with_prop("partial", False)
 
