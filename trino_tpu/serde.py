@@ -298,16 +298,29 @@ class Spiller:
     checkpoint/resume: spill/unspill is the reference's only
     state-offload mechanism)."""
 
+    # every live spill file across instances, for the leak detector
+    # (server/diagnostics.py — a spill file outliving its query is the
+    # reference's revocable-memory leak analog)
+    _LIVE: "set[str]" = set()
+    _LIVE_LOCK = threading.Lock()
+
     def __init__(self, directory: Optional[str] = None):
         import tempfile
         self._dir = directory or tempfile.mkdtemp(prefix="trino_tpu_spill_")
         self._files: list = []
+
+    @classmethod
+    def live_files(cls) -> list:
+        with cls._LIVE_LOCK:
+            return sorted(cls._LIVE)
 
     def spill(self, batch: Batch) -> str:
         path = os.path.join(self._dir, f"page_{len(self._files)}.bin")
         with open(path, "wb") as f:
             f.write(serialize_batch(batch))
         self._files.append(path)
+        with Spiller._LIVE_LOCK:
+            Spiller._LIVE.add(path)
         return path
 
     def unspill(self, path: str) -> Batch:
@@ -318,9 +331,13 @@ class Spiller:
         return [self.unspill(p) for p in self._files]
 
     def close(self):
+        gone = []
         for p in self._files:
             try:
                 os.unlink(p)
+                gone.append(p)
             except OSError:
-                pass
+                pass        # stays in _LIVE: still on disk == a leak
+        with Spiller._LIVE_LOCK:
+            Spiller._LIVE.difference_update(gone)
         self._files.clear()

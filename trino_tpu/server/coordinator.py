@@ -171,8 +171,13 @@ class QueryTracker:
             # fast-finishing query cannot race past run_and_release's
             # slot release (q.group would still be None)
             q.group = group
-            threading.Thread(target=run_and_release,
-                             daemon=True).start()
+            t = threading.Thread(target=run_and_release, daemon=True,
+                                 name=f"query-{qid}")
+            # tag for the leak detector: a thread outliving its
+            # query's terminal state is an orphan
+            # (server/diagnostics.py)
+            t.trino_query_id = qid
+            t.start()
 
         if self.groups is None:
             start()
@@ -412,6 +417,12 @@ class Coordinator:
 
     def kill_query(self, query_id: str) -> bool:
         return self.tracker.cancel(query_id)
+
+    def leak_report(self, stuck_after_s: float = 3600.0):
+        """Leak/orphan snapshot (execution/QueryTracker
+        enforceTimeLimits + ClusterMemoryLeakDetector analogs)."""
+        from .diagnostics import leak_report
+        return leak_report(self, stuck_after_s=stuck_after_s)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: wait for active queries to finish
