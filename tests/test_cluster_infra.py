@@ -365,7 +365,9 @@ def test_leak_report_clean_and_detects():
         time.sleep(0.5)
         assert coord.leak_report(stuck_after_s=0.1).stuck_queries
         q2.do_cancel()
-        rep = coord.leak_report()
+        # grace 0: the canceled query's thread is still in the slow
+        # scan, which is exactly the orphan shape
+        rep = coord.leak_report(orphan_grace_s=0.0)
         assert any("query" in t for t in rep.orphaned_threads)
         q2_thread_done = q2.wait_done(30)
         assert q2_thread_done

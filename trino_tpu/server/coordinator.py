@@ -418,11 +418,13 @@ class Coordinator:
     def kill_query(self, query_id: str) -> bool:
         return self.tracker.cancel(query_id)
 
-    def leak_report(self, stuck_after_s: float = 3600.0):
+    def leak_report(self, stuck_after_s: float = 3600.0,
+                    orphan_grace_s: float = 5.0):
         """Leak/orphan snapshot (execution/QueryTracker
         enforceTimeLimits + ClusterMemoryLeakDetector analogs)."""
         from .diagnostics import leak_report
-        return leak_report(self, stuck_after_s=stuck_after_s)
+        return leak_report(self, stuck_after_s=stuck_after_s,
+                           orphan_grace_s=orphan_grace_s)
 
     def drain(self, timeout: float = 30.0) -> bool:
         """Graceful shutdown: wait for active queries to finish
