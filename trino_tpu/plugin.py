@@ -113,3 +113,9 @@ def _ensure_builtins() -> None:
         from .connectors.localfile import LocalFileConnector
         return LocalFileConnector(p.get("localfile.root", "."))
     register_factory(ConnectorFactory("localfile", _localfile))
+
+    def _jdbc(n, p):
+        from .connectors.jdbc import SqliteConnector
+        return SqliteConnector(p.get("connection-url", ":memory:"),
+                               p.get("jdbc.schema", "public"))
+    register_factory(ConnectorFactory("jdbc", _jdbc))
