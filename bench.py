@@ -171,6 +171,34 @@ def _leg_q18(schema: str) -> float:
     return rows / dt
 
 
+def _leg_telemetry(schema: str, iters: int) -> float:
+    """Fractional overhead of per-node stats collection: TPC-H q1
+    through the full engine with collect_node_stats OFF vs ON (the
+    always-on OperatorStats question — the stats fence adds a device
+    sync per plan node, so this ratio is what decides whether stats
+    can default on). Returned as a fraction (0.03 = 3% slower)."""
+    import trino_tpu  # noqa: F401
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.session import Session
+
+    def best(collect: bool) -> float:
+        r = LocalQueryRunner(
+            session=Session(catalog="tpch", schema=schema),
+            collect_node_stats=collect)
+        r.execute(TPCH_QUERIES[1])      # generate + compile + warm
+        b = float("inf")
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            r.execute(TPCH_QUERIES[1])
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    off = best(False)
+    on = best(True)
+    return max(on / off - 1.0, 0.0)
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -182,15 +210,20 @@ def _run_probe_body(kind: str):
         legs = [("q18", lambda: _leg_q18(sf))]
     elif kind == "device":
         legs = [("engine", lambda: _leg_engine("sf1", 2)),
-                ("micro", lambda: _leg_micro(1.0, 3))]
+                ("micro", lambda: _leg_micro(1.0, 3)),
+                ("telemetry", lambda: _leg_telemetry("sf1", 2))]
     else:
         legs = [("engine", lambda: _leg_engine("sf1", 2)),
-                ("micro", lambda: _leg_micro(0.1, 2))]
+                ("micro", lambda: _leg_micro(0.1, 2)),
+                ("telemetry", lambda: _leg_telemetry("sf1", 2))]
     for name, fn in legs:
         try:
-            rps = fn()
-            print(json.dumps({"leg": name, "rows_per_sec": rps}),
-                  flush=True)
+            if name == "telemetry":
+                print(json.dumps(
+                    {"leg": name, "overhead": fn()}), flush=True)
+            else:
+                print(json.dumps({"leg": name, "rows_per_sec": fn()}),
+                      flush=True)
         except Exception as e:  # report, keep going to the next leg
             print(json.dumps(
                 {"leg": name,
@@ -234,11 +267,14 @@ def _probe(kind: str, timeout: float):
             continue
         if "rows_per_sec" in d:
             vals[d.get("leg", "?")] = d["rows_per_sec"]
+        elif "overhead" in d:
+            vals[d.get("leg", "?")] = d["overhead"]
         elif "error" in d:
             errs[d.get("leg", "?")] = d["error"]
     if err_note:
         errs.setdefault("probe", err_note)
-    expected = ("q18",) if kind == "scale" else ("engine", "micro")
+    expected = ("q18",) if kind == "scale" else \
+        ("engine", "micro", "telemetry")
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -326,6 +362,12 @@ def main():
         # the ratio divides the rates directly
         "micro_vs_cpu": (round(tpu_micro / cpu_micro, 2)
                          if tpu_micro and cpu_micro else 0.0),
+        # observability-regression tripwire: q1 with per-node stats
+        # collection on vs off (obs/ subsystem); device preferred,
+        # CPU fallback — target < 0.05 (tests/test_observability.py)
+        "telemetry_overhead": round(
+            dev_vals.get("telemetry",
+                         cpu_vals.get("telemetry", 0.0)) or 0.0, 4),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale. sf100 lineitem

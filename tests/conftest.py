@@ -25,3 +25,9 @@ jax.config.update("jax_platforms",
                   os.environ.get("TRINO_TPU_TEST_PLATFORM", "cpu"))
 
 import trino_tpu  # noqa: E402,F401  (enables x64)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: benchmark-grade tests excluded from the tier-1 run")

@@ -1,0 +1,409 @@
+"""Telemetry subsystem tests (trino_tpu/obs/).
+
+Covers the three layers end-to-end:
+- metrics registry: Prometheus exposition parsed BACK and asserted on
+  (counter monotonicity across queries, jit cache hit/miss, query-state
+  counters) — reference analog: the JMX stats the web UI scrapes;
+- query tracing: span-tree shape for a single-node and a distributed
+  query (parse -> plan -> optimize -> execute with jit_trace /
+  device_execute and per-fragment children);
+- rich operator stats + the distributed rollup: worker-reported rows
+  summing to coordinator totals, per-fragment EXPLAIN ANALYZE numbers.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from trino_tpu.obs.metrics import (METRICS, MetricsRegistry,
+                                   parse_exposition)
+from trino_tpu.obs.trace import QueryTrace
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+
+
+# ---------------------------------------------------------------------------
+# metrics registry unit tests
+# ---------------------------------------------------------------------------
+
+def test_registry_counter_labels_and_render():
+    reg = MetricsRegistry()
+    c = reg.counter("t_total", "help text", ("op", "ok"))
+    c.inc(op="scan", ok="true")
+    c.inc(2, op="scan", ok="false")
+    assert c.value(op="scan", ok="true") == 1
+    assert c.value(op="scan", ok="false") == 2
+    text = reg.render()
+    assert "# TYPE t_total counter" in text
+    parsed = parse_exposition(text)
+    assert parsed["t_total"][("op=scan", "ok=false")] == 2.0
+
+
+def test_registry_counter_rejects_label_drift_and_negatives():
+    reg = MetricsRegistry()
+    c = reg.counter("t2_total", "", ("a",))
+    with pytest.raises(ValueError):
+        c.inc(b="x")
+    with pytest.raises(ValueError):
+        c.inc(-1, a="x")
+    # get-or-create is idempotent, kind mismatch is not
+    assert reg.counter("t2_total", "", ("a",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("t2_total")
+
+
+def test_registry_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("t_seconds", "", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5.0)
+    parsed = parse_exposition(reg.render())
+    assert parsed["t_seconds_bucket"][("le=0.1",)] == 1.0
+    assert parsed["t_seconds_bucket"][("le=1",)] == 2.0
+    assert parsed["t_seconds_bucket"][("le=+Inf",)] == 3.0
+    assert parsed["t_seconds_count"][()] == 3.0
+    assert parsed["t_seconds_sum"][()] == pytest.approx(5.55)
+
+
+def test_registry_collector_refreshes_gauge_at_render():
+    reg = MetricsRegistry()
+    g = reg.gauge("t_depth", "")
+    state = {"n": 0}
+    reg.register_collector(lambda: g.set(state["n"]))
+    state["n"] = 7
+    assert parse_exposition(reg.render())["t_depth"][()] == 7.0
+
+
+def test_trace_span_nesting_and_lines():
+    tr = QueryTrace("q1")
+    with tr.span("plan"):
+        pass
+    with tr.span("execute"):
+        with tr.span("jit_trace", cache="chain"):
+            pass
+    assert [s.name for s in tr.roots] == ["plan", "execute"]
+    assert tr.roots[1].children[0].name == "jit_trace"
+    d = tr.to_dicts()
+    assert d[1]["children"][0]["attrs"] == {"cache": "chain"}
+    assert any("jit_trace" in l for l in tr.lines())
+
+
+# ---------------------------------------------------------------------------
+# single-node: spans, node stats, explain analyze
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny"),
+        collect_node_stats=True)
+
+
+def test_span_tree_single_node(runner):
+    res = runner.execute(
+        "SELECT l_returnflag, count(*) FROM lineitem "
+        "GROUP BY l_returnflag")
+    names = [s.name for s in res.trace.roots]
+    assert names == ["parse", "plan", "optimize", "execute"]
+    assert all(s.wall_s >= 0 for s in res.trace.roots)
+    assert res.trace.query_id == res.query_id
+
+
+def test_node_stats_rows_and_bytes(runner):
+    res = runner.execute(
+        "SELECT count(*) AS n FROM lineitem WHERE l_quantity > 30")
+    scan = [s for s in res.stats if s.name == "TableScan"]
+    agg = [s for s in res.stats if s.name == "Aggregation"]
+    assert scan and agg
+    # the scan fed the aggregation: its output IS the agg's input
+    # (pushdown may shrink the scan below the table row count)
+    assert agg[0].input_rows == scan[0].output_rows > 0
+    assert agg[0].output_rows == 1
+    assert all(s.output_bytes >= 0 for s in res.stats)
+    assert scan[0].output_bytes > 0
+
+
+def test_explain_analyze_reports_flow(runner):
+    res = runner.execute(
+        "EXPLAIN ANALYZE SELECT count(*) FROM orders")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "TableScan" in text
+    assert " in " in text and " out " in text and " rows" in text
+    assert "Trace:" in text
+    assert "execute" in text
+
+
+def test_jit_cache_counters_and_compile_attribution(runner):
+    from trino_tpu.exec.executor import Executor, _M_JIT
+    plan = runner.plan_sql(
+        "SELECT l_orderkey + 7 AS k FROM lineitem "
+        "WHERE l_quantity > 30")
+    before_hit = _M_JIT.value(cache="chain", result="hit")
+    before_miss = _M_JIT.value(cache="chain", result="miss")
+    sess = Session(catalog="tpch", schema="tiny")
+    for _ in range(2):
+        ex = Executor(runner.catalogs, sess, collect_stats=True,
+                      fragment_jit=True)
+        ex.execute(plan)
+    # first executor misses (trace+compile), second hits the
+    # cross-query structural cache
+    assert _M_JIT.value(cache="chain", result="miss") == before_miss + 1
+    assert _M_JIT.value(cache="chain", result="hit") == before_hit + 1
+    assert any(s.cache_hit is True for s in ex.stats)
+
+
+def test_peak_memory_reported(runner):
+    res = runner.execute("SELECT count(*) FROM orders")
+    assert res.peak_memory_bytes > 0
+    assert res.spill_bytes == 0
+
+
+# ---------------------------------------------------------------------------
+# coordinator: /metrics exposition + query detail
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def coordinator():
+    from trino_tpu.server import Coordinator
+    co = Coordinator().start()
+    yield co
+    co.stop()
+
+
+def _scrape(co):
+    with urllib.request.urlopen(f"{co.base_uri}/metrics") as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        return parse_exposition(r.read().decode())
+
+
+def _run(co, sql):
+    from trino_tpu.client import StatementClient
+    return StatementClient(co.base_uri, catalog="tpch",
+                           schema="tiny").execute(sql)
+
+
+def test_metrics_endpoint_counters_monotonic(coordinator):
+    _run(coordinator, "SELECT 1")
+    m1 = _scrape(coordinator)
+    finished1 = m1["trino_tpu_query_states_total"][("state=FINISHED",)]
+    assert finished1 >= 1
+    assert m1["trino_tpu_query_states_total"][("state=QUEUED",)] >= \
+        finished1
+    _run(coordinator, "SELECT count(*) FROM orders")
+    m2 = _scrape(coordinator)
+    finished2 = m2["trino_tpu_query_states_total"][("state=FINISHED",)]
+    assert finished2 == finished1 + 1
+    # gauges from the render-time collector
+    assert m2["trino_tpu_queries"][("state=FINISHED",)] >= 2
+    assert ("trino_tpu_queue_depth" in m2)
+    # the runner-level wall histogram grew with the queries
+    assert m2["trino_tpu_query_wall_seconds_count"][()] > \
+        m1["trino_tpu_query_wall_seconds_count"][()] - 1
+
+
+def test_metrics_endpoint_includes_jit_and_scan_counters(coordinator):
+    # drive the structural jit cache (fragment_jit is off on CPU by
+    # default, so tick it explicitly through a jitted chain)
+    from trino_tpu.exec.executor import Executor
+    r = LocalQueryRunner(session=Session(catalog="tpch",
+                                         schema="tiny"))
+    plan = r.plan_sql("SELECT l_orderkey * 2 AS k FROM lineitem "
+                      "WHERE l_quantity > 40")
+    Executor(r.catalogs, r.session, fragment_jit=True).execute(plan)
+    _run(coordinator, "SELECT count(*) FROM lineitem")
+    m = _scrape(coordinator)
+    jit = m["trino_tpu_jit_cache_total"]
+    assert sum(jit.values()) >= 1
+    assert any("result=hit" in k or "result=miss" in k
+               for key in jit for k in key)
+    scan = m["trino_tpu_scan_cache_total"]
+    assert sum(scan.values()) >= 1
+
+
+def test_query_detail_serves_cached_plan_and_spans(coordinator):
+    res = _run(coordinator,
+               "SELECT o_orderpriority, count(*) FROM orders "
+               "GROUP BY o_orderpriority")
+    q = coordinator.tracker.get(res.query_id)
+    # the plan was captured at execution time, not re-derived per GET
+    assert q.result.plan_lines
+    with urllib.request.urlopen(
+            f"{coordinator.base_uri}/v1/query/{res.query_id}") as r:
+        d = json.loads(r.read())
+    assert d["plan"] == q.result.plan_lines
+    assert "planError" not in d
+    spans = d.get("spans") or []
+    assert [s["name"] for s in spans] == \
+        ["parse", "plan", "optimize", "execute"]
+    stats = d.get("nodeStats") or []
+    assert stats and all("inputRows" in s and "compileMillis" in s
+                         for s in stats)
+    assert d["peakMemoryBytes"] > 0
+
+
+def test_enriched_query_completed_event(coordinator):
+    from trino_tpu.server.events import EventListener
+    done = []
+
+    class L(EventListener):
+        def query_completed(self, event):
+            done.append(event)
+
+    coordinator.tracker.events.add_listener(L())
+    _run(coordinator, "SELECT count(*) FROM orders")
+    ev = done[-1]
+    assert ev.state == "FINISHED"
+    assert ev.peak_memory_bytes > 0
+    assert ev.cumulative_operator_stats is not None
+    assert ev.cumulative_operator_stats["output_rows"] >= 1
+    assert ev.operator_summaries and \
+        ev.operator_summaries[0].get("name")
+
+
+def test_split_completed_event_fires_with_wall_time():
+    from trino_tpu.server.events import (EventListener,
+                                         EventListenerManager)
+    got = []
+
+    class L(EventListener):
+        def split_completed(self, event):
+            got.append(event)
+
+    mgr = EventListenerManager()
+    mgr.add_listener(L())
+    sess = Session(catalog="tpch", schema="tiny", events=mgr)
+    r = LocalQueryRunner(session=sess)
+    r.execute("SELECT count(*) FROM orders")
+    assert got, "no SplitCompletedEvent emitted"
+    ev = got[0]
+    assert ev.query_id.startswith("query_")
+    assert "tpch.tiny.orders" in ev.split_id
+    assert ev.wall_s >= 0
+
+
+# ---------------------------------------------------------------------------
+# distributed: rollup + per-fragment explain analyze + worker /metrics
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def worker_uris():
+    from trino_tpu.server.task_worker import TaskWorkerServer
+    workers = [TaskWorkerServer().start() for _ in range(2)]
+    yield [w.base_uri for w in workers]
+    for w in workers:
+        w.stop()
+
+
+def test_distributed_stats_rollup_sums_to_totals(worker_uris):
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    d = DistributedHostQueryRunner(
+        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        collect_node_stats=True)
+    res = d.execute("SELECT count(*) AS n FROM lineitem")
+    total = res.rows[0][0]
+    frag = [s for s in res.stats if "fragment" in s.detail]
+    assert frag, "no fragment-stage stats in the rollup"
+    # worker-reported input rows across the stage == the table rows the
+    # coordinator counted
+    agg_in = [s.input_rows for s in frag if s.name == "Aggregation"]
+    assert agg_in and agg_in[0] == total
+    # the coordinator combine consumed exactly the worker partials
+    combine = [s for s in res.stats
+               if s.name == "Aggregation" and "fragment" not in s.detail]
+    frag_out = [s.output_rows for s in frag
+                if s.name == "Aggregation"][0]
+    assert combine and combine[0].input_rows == frag_out
+
+
+def test_distributed_span_tree_has_fragment_children(worker_uris):
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    d = DistributedHostQueryRunner(
+        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        collect_node_stats=True)
+    res = d.execute("SELECT sum(l_quantity) FROM lineitem")
+    roots = [s.name for s in res.trace.roots]
+    assert roots == ["plan", "optimize", "execute"]
+    execute = res.trace.roots[-1]
+    kids = [c.name for c in execute.children]
+    assert "schedule" in kids
+    frags = [c for c in execute.children
+             if c.name.startswith("fragment_")]
+    assert len(frags) == 2          # one per worker
+    # the worker's own task_execute subtree was grafted under it
+    assert any(g.name == "task_execute"
+               for f in frags for g in f.children)
+
+
+def test_distributed_explain_analyze_per_fragment(worker_uris):
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    d = DistributedHostQueryRunner(
+        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        collect_node_stats=True)
+    res = d.execute(
+        "EXPLAIN ANALYZE SELECT l_returnflag, count(*) FROM lineitem "
+        "GROUP BY l_returnflag")
+    text = "\n".join(r[0] for r in res.rows)
+    assert "fragment 0 x2 workers" in text
+    assert " in " in text and " rows" in text
+    assert "Trace:" in text and "fragment_0_execute" in text
+
+
+def test_worker_metrics_endpoint_and_task_stats(worker_uris):
+    from trino_tpu.server.task_worker import RemoteTaskClient
+    from trino_tpu.plan.serde import to_jsonable
+    r = LocalQueryRunner(session=Session(catalog="tpch",
+                                         schema="tiny"))
+    plan = r.plan_sql("SELECT o_orderkey FROM orders "
+                      "WHERE o_orderkey < 100")
+    client = RemoteTaskClient(worker_uris[0])
+    client.submit_fragment("obs-task-1", to_jsonable(plan),
+                           catalog="tpch", schema="tiny", part=0,
+                           nparts=1, collect_stats=True)
+    pages = client.pages("obs-task-1")
+    assert pages
+    status = client.status("obs-task-1")
+    assert status["state"] == "FINISHED"
+    stats = status["nodeStats"]
+    assert stats and any(s["name"] == "TableScan" for s in stats)
+    assert status["spans"] and \
+        status["spans"][0]["name"] == "task_execute"
+    with urllib.request.urlopen(f"{worker_uris[0]}/metrics") as resp:
+        m = parse_exposition(resp.read().decode())
+    tasks = m["trino_tpu_worker_tasks_total"]
+    assert tasks.get(("state=FINISHED",), 0) >= 1
+    assert sum(m["trino_tpu_exchange_pages_total"].values()) >= 1
+
+
+# ---------------------------------------------------------------------------
+# overhead budget (bench.py telemetry_overhead tripwire)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_5_percent():
+    """Stats collection must stay cheap enough to leave always-on at
+    the coordinator (the reference keeps OperatorStats always-on);
+    bench.py emits the same measurement as telemetry_overhead.
+    Iterations INTERLEAVE the two modes so machine-load drift hits
+    both sides equally; best-of-N per side."""
+    import time as _time
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    sql = TPCH_QUERIES[1]
+    runners = {
+        collect: LocalQueryRunner(
+            session=Session(catalog="tpch", schema="sf1"),
+            collect_node_stats=collect)
+        for collect in (False, True)}
+    for r in runners.values():
+        r.execute(sql)                    # warm: generate + compile
+    best = {False: float("inf"), True: float("inf")}
+    for _ in range(5):
+        for collect, r in runners.items():
+            t0 = _time.perf_counter()
+            r.execute(sql)
+            best[collect] = min(best[collect],
+                                _time.perf_counter() - t0)
+    overhead = best[True] / best[False] - 1.0
+    assert overhead < 0.05, \
+        f"telemetry overhead {overhead:.1%} exceeds 5%"

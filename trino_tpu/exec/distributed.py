@@ -50,7 +50,7 @@ from ..plan.nodes import (AggregationNode, FilterNode, JoinNode, LimitNode,
 from ..planner.logical import SemiJoinMultiNode
 from ..session import Session
 from ..types import BOOLEAN, BIGINT, is_string
-from .executor import (Executor, QueryError, _lower_aggregates,
+from .executor import (Executor, QueryError, _Pre, _lower_aggregates,
                        device_concat, join_verify_filter)
 from .expr import eval_expr, eval_predicate
 
@@ -84,11 +84,20 @@ class DistributedExecutor(Executor):
         cancel = getattr(self.session, "cancel", None)
         if cancel is not None and cancel.is_set():
             raise QueryError("Query was canceled")
-        method = getattr(self, "_dexec_" + type(node).__name__, None)
-        if method is not None:
-            return method(node)
-        # local fallback: materialize sharded sources on host
-        return self._exec_local(node)
+
+        def inner():
+            method = getattr(self, "_dexec_" + type(node).__name__,
+                             None)
+            if method is not None:
+                return method(node)
+            # local fallback: materialize sharded sources on host
+            return self._exec_local(node)
+
+        if not self.collect_stats:
+            return inner()
+        # same per-node stats discipline as the local executor
+        # (previously the mesh path silently collected nothing)
+        return self._stats_wrap(node, inner)
 
     def _exec_local(self, node: PlanNode) -> Batch:
         method = getattr(super(), "_exec_" + type(node).__name__, None)
@@ -133,8 +142,9 @@ class DistributedExecutor(Executor):
             per_dev[i % n].append(s)
         parts = []
         for d in range(n):
-            from .executor import read_split_cached
-            batches = [read_split_cached(conn, s, columns)
+            # _read_split = read_split_cached + telemetry (split
+            # counter, SplitCompletedEvent, input-flow accounting)
+            batches = [self._read_split(conn, s, columns)
                        for s in per_dev[d]]
             if not batches:
                 from ..columnar import empty_batch
@@ -662,23 +672,6 @@ class DistributedExecutor(Executor):
 # helpers
 # --------------------------------------------------------------------------
 
-class _Pre(PlanNode):
-    """Wraps an already-computed Batch so parent-class handlers can
-    recurse through self.execute() transparently."""
-
-    __slots__ = ("batch",)
-
-    def __init__(self, batch: Batch):
-        self.batch = batch
-
-    @property
-    def sources(self):
-        return ()
-
-    def output_schema(self):
-        return self.batch.schema()
-
-
 def _combine_kind(kind: str) -> str:
     return _COMBINABLE[kind]
 
@@ -878,10 +871,3 @@ def _shard_join(pb: Batch, bb: Batch, pkeys, bkeys, jt: str, filt,
     return _trace_concat(out, pad, out_cap + pad_cap)
 
 
-def _install_pre_handler():
-    def _exec_pre(self, node: _Pre) -> Batch:
-        return node.batch
-    Executor._exec__Pre = _exec_pre
-
-
-_install_pre_handler()

@@ -48,14 +48,156 @@ class QueryError(Exception):
     pass
 
 
+class _Pre(PlanNode):
+    """Wraps an already-computed Batch so handlers can recurse through
+    self.execute() transparently (used by the distributed executors to
+    pre-materialize sources and by the remote scheduler to substitute
+    gathered fragments). Lives here — NOT in exec/distributed.py — so
+    the host-worker dispatch path (exec/remote.py) stays importable
+    when the mesh stack (parallel/spmd.py) is unavailable."""
+
+    __slots__ = ("batch",)
+
+    def __init__(self, batch):
+        self.batch = batch
+
+    @property
+    def sources(self):
+        return ()
+
+    def output_schema(self):
+        return self.batch.schema()
+
+
 @dataclass
 class NodeStats:
-    """OperatorStats analog (operator/OperatorStats.java): wall time and
-    row counts per plan node, powering EXPLAIN ANALYZE."""
+    """OperatorStats analog (operator/OperatorStats.java): per-plan-node
+    wall time, row/byte flow, compile (jit-trace) wall, and cache-hit
+    flags, powering EXPLAIN ANALYZE, /v1/query/{id}, and the distributed
+    stats rollup (workers serialize these in task results; the
+    coordinator merges them per stage — see merge_node_stats)."""
     name: str
     detail: str = ""
     wall_s: float = 0.0
     output_rows: int = -1
+    input_rows: int = -1
+    input_bytes: int = -1
+    output_bytes: int = -1
+    compile_s: float = 0.0
+    cache_hit: Optional[bool] = None
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "detail": self.detail,
+                "wall_s": self.wall_s, "output_rows": self.output_rows,
+                "input_rows": self.input_rows,
+                "input_bytes": self.input_bytes,
+                "output_bytes": self.output_bytes,
+                "compile_s": self.compile_s,
+                "cache_hit": self.cache_hit}
+
+    @staticmethod
+    def from_dict(d: dict) -> "NodeStats":
+        return NodeStats(
+            d.get("name", "?"), d.get("detail", ""),
+            float(d.get("wall_s", 0.0)), int(d.get("output_rows", -1)),
+            int(d.get("input_rows", -1)), int(d.get("input_bytes", -1)),
+            int(d.get("output_bytes", -1)),
+            float(d.get("compile_s", 0.0)), d.get("cache_hit"))
+
+
+def _sum_counts(vals: Sequence[int]) -> int:
+    known = [v for v in vals if v is not None and v >= 0]
+    return sum(known) if known else -1
+
+
+def merge_node_stats(
+        per_worker: Sequence[Sequence["NodeStats"]]) -> List["NodeStats"]:
+    """Roll worker-reported per-node stats up into one per-stage list.
+    Every worker executed the SAME fragment plan, but fast paths
+    (streaming aggregation fuses scan+agg into one entry; an empty
+    split share takes the generic path) mean the lists need not align
+    positionally — entries merge by (node name, occurrence index),
+    ordered by the most detailed worker's list. Rows/bytes sum across
+    workers (they partition the input); wall and compile take the max
+    (the stage's critical path — tasks run concurrently); cache_hit
+    ANDs (one cold worker means the stage paid a compile)."""
+    lists = [list(l) for l in per_worker if l]
+    if not lists:
+        return []
+
+    def keyed(l: Sequence["NodeStats"]):
+        seen: Dict[str, int] = {}
+        out = []
+        for s in l:
+            i = seen.get(s.name, 0)
+            seen[s.name] = i + 1
+            out.append(((s.name, i), s))
+        return out
+
+    base = max(lists, key=len)
+    by_key: Dict[tuple, List[NodeStats]] = {}
+    extras: List[tuple] = []
+    # base is first in the stable descending sort, so keys discovered
+    # in OTHER lists are by construction not base keys
+    for l in sorted(lists, key=len, reverse=True):
+        for k, s in keyed(l):
+            if k not in by_key:
+                by_key[k] = []
+                if l is not base:
+                    extras.append(k)  # go after base's order
+            by_key[k].append(s)
+    order = [k for k, _ in keyed(base)] + extras
+    merged: List[NodeStats] = []
+    for k in order:
+        same = by_key[k]
+        hits = [s.cache_hit for s in same if s.cache_hit is not None]
+        merged.append(NodeStats(
+            same[0].name, same[0].detail,
+            max(s.wall_s for s in same),
+            _sum_counts([s.output_rows for s in same]),
+            _sum_counts([s.input_rows for s in same]),
+            _sum_counts([s.input_bytes for s in same]),
+            _sum_counts([s.output_bytes for s in same]),
+            max(s.compile_s for s in same),
+            all(hits) if hits else None))
+    return merged
+
+
+def render_analyze_lines(plan_lines, stats, trace) -> List[str]:
+    """The EXPLAIN ANALYZE text body: plan tree, per-node stats, and
+    the span-tree section — one renderer shared by the local runner
+    and the distributed host runner so the formats cannot drift."""
+    lines = list(plan_lines or [])
+    lines.append("")
+    lines.extend(stats_lines(stats or []))
+    if trace is not None and trace.roots:
+        lines.append("")
+        lines.append("Trace:")
+        lines.extend(trace.lines())
+    return lines
+
+
+def stats_lines(stats: Sequence["NodeStats"]) -> List[str]:
+    """EXPLAIN ANALYZE text rendering of a NodeStats list (reference:
+    planprinter/PlanPrinter's textDistributedPlan stats columns)."""
+    out = []
+    for s in stats:
+        parts = [f"{s.name}: {s.wall_s * 1000:.2f}ms"]
+        if s.input_rows >= 0:
+            parts.append(f"in {s.input_rows} rows"
+                         + (f"/{s.input_bytes}B"
+                            if s.input_bytes >= 0 else ""))
+        parts.append(f"out {s.output_rows} rows"
+                     + (f"/{s.output_bytes}B"
+                        if s.output_bytes >= 0 else ""))
+        if s.compile_s > 0:
+            parts.append(f"compile {s.compile_s * 1000:.2f}ms")
+        if s.cache_hit is not None:
+            parts.append("cache hit" if s.cache_hit else "cache miss")
+        if s.detail:
+            parts.append(s.detail)
+        out.append(", ".join(parts))
+    return out
 
 
 # plan nodes whose _apply_ is pure jnp (traceable): a chain of these over
@@ -75,6 +217,26 @@ _STREAM_JIT_CACHE: Dict[tuple, object] = {}
 _STREAM_JIT_DENY: set = set()
 _CHAIN_JIT_CACHE: Dict[tuple, object] = {}
 _CHAIN_JIT_DENY: set = set()
+
+# process metrics (obs/metrics.py; scraped at GET /metrics). These are
+# per-query-phase increments, never per-row — the lock cost is noise.
+from ..obs.metrics import METRICS as _METRICS
+_M_JIT = _METRICS.counter(
+    "trino_tpu_jit_cache_total",
+    "Structural jitted-program cache lookups by cache and outcome",
+    ("cache", "result"))
+_M_SCAN = _METRICS.counter(
+    "trino_tpu_scan_cache_total",
+    "HBM-resident scan cache lookups by granularity and outcome",
+    ("cache", "result"))
+_M_SCAN_BYTES = _METRICS.gauge(
+    "trino_tpu_scan_cache_bytes",
+    "Bytes of table lanes resident in the scan cache", ("connector",))
+_M_SPILL = _METRICS.counter(
+    "trino_tpu_spill_bytes_total",
+    "Bytes written to host RAM by oversized-join spill")
+_M_SPLITS = _METRICS.counter(
+    "trino_tpu_splits_read_total", "Table splits read by the executor")
 
 
 # volatility lives in rex (a property of expressions, shared with the
@@ -180,6 +342,12 @@ class Executor:
         self.fragment_jit = fragment_jit
         self._no_jit_chains: set = set()
         self._jit_chains: dict = {}
+        # per-query telemetry accumulators (obs/): stat frames track
+        # each node's input flow (children add their output on exit);
+        # peak/spill feed the enriched QueryCompletedEvent
+        self._frames: List[dict] = []
+        self.peak_reserved_bytes: int = 0
+        self.spilled_bytes: int = 0
         # remote-task split addressing: (part, nparts) makes every scan
         # read only splits with index % nparts == part (the worker's
         # share of a fragment — server/task_worker.py fragment payloads;
@@ -193,6 +361,12 @@ class Executor:
         not pin its first query's executor object graph."""
         return Executor(self.catalogs, self.session)
 
+    @property
+    def trace(self):
+        """The current query's span tree (obs/trace.py), carried on the
+        Session by the runner; None outside a traced query."""
+        return getattr(self.session, "trace", None)
+
     # ------------------------------------------------------------------
     def execute(self, node: PlanNode) -> Batch:
         cancel = getattr(self.session, "cancel", None)
@@ -200,15 +374,88 @@ class Executor:
             # cooperative cancellation between plan nodes (reference:
             # Driver loop checks the yield/termination signal)
             raise QueryError("Query was canceled")
-        t0 = time.perf_counter() if self.collect_stats else 0.0
-        out = self._execute_inner(node)
-        if self.collect_stats:
-            # blocking read for accurate per-node timing
-            n = out.num_rows_host()
+        if not self.collect_stats:
+            return self._execute_inner(node)
+        return self._stats_wrap(node, lambda: self._execute_inner(node))
+
+    def _stats_wrap(self, node: PlanNode, fn):
+        """Time one node's execution and record a NodeStats entry.
+        A frame on the stack accumulates this node's input flow: every
+        child node adds its own output rows/bytes to the parent frame
+        on exit, and split reads add the scanned rows directly."""
+        frame = {"rows": 0, "bytes": 0, "compile_s": 0.0, "cache": None}
+        self._frames.append(frame)
+        t0 = time.perf_counter()
+        try:
+            out = fn()
+        finally:
+            self._frames.pop()
+        # blocking read for accurate per-node timing
+        n = (out.total_rows_host() if hasattr(out, "total_rows_host")
+             else out.num_rows_host())
+        obytes = sum(_col_bytes(c) for c in out.columns.values())
+        name = type(node).__name__.replace("Node", "")
+        if not name.startswith("_"):
+            # internal wrappers (_Pre preloaded batches) are plumbing,
+            # not operators — they feed the parent's input, no entry
             self.stats.append(NodeStats(
-                type(node).__name__.replace("Node", ""),
-                wall_s=time.perf_counter() - t0, output_rows=n))
+                name, wall_s=time.perf_counter() - t0, output_rows=n,
+                input_rows=frame["rows"], input_bytes=frame["bytes"],
+                output_bytes=obytes, compile_s=frame["compile_s"],
+                cache_hit=frame["cache"]))
+        if self._frames:
+            parent = self._frames[-1]
+            parent["rows"] += n
+            parent["bytes"] += obytes
         return out
+
+    def _jit_call(self, jitted, args: tuple, cache: str, hit: bool):
+        """Invoke a jitted program, separating jit_trace (first, cache-
+        miss call: trace + XLA compile + execute) from device_execute
+        (steady state) in the query trace and attributing compile wall
+        to the current node's stats frame. On a tensor runtime this
+        split is the headline latency number (PAPERS.md)."""
+        tr = self.trace
+        if tr is None and not self.collect_stats:
+            return jitted(*args)
+        t0 = time.perf_counter()
+        try:
+            return jitted(*args)
+        finally:
+            t1 = time.perf_counter()
+            if tr is not None:
+                tr.record("device_execute" if hit else "jit_trace",
+                          t0, t1, cache=cache)
+            if not hit and self._frames:
+                self._frames[-1]["compile_s"] += t1 - t0
+                if self._frames[-1]["cache"] is None:
+                    self._frames[-1]["cache"] = False
+            elif hit and self._frames \
+                    and self._frames[-1]["cache"] is None:
+                self._frames[-1]["cache"] = True
+
+    def _read_split(self, conn, split, columns) -> Batch:
+        """Split read with telemetry: wall-timed for the
+        SplitCompletedEvent (fired when the session carries an event
+        manager — the task/split completion path), counted into the
+        metrics registry, and charged to the current node's input."""
+        t0 = time.perf_counter()
+        b = read_split_cached(conn, split, columns)
+        wall = time.perf_counter() - t0
+        _M_SPLITS.inc()
+        if self.collect_stats and self._frames:
+            self._frames[-1]["rows"] += b.num_rows_host()
+            self._frames[-1]["bytes"] += sum(
+                _col_bytes(c) for c in b.columns.values())
+        events = getattr(self.session, "events", None)
+        if events is not None:
+            from ..server.events import SplitCompletedEvent
+            h = split.handle
+            events.split_completed(SplitCompletedEvent(
+                getattr(self.session, "query_id", "") or "",
+                f"{h.catalog}.{h.schema}.{h.table}"
+                f"[{split.part}/{split.part_count}]", wall))
+        return b
 
     def _execute_inner(self, node: PlanNode) -> Batch:
         if isinstance(node, AggregationNode):
@@ -379,6 +626,12 @@ class Executor:
             if fullkey not in _STREAM_JIT_DENY:
                 full_jit = (_STREAM_JIT_CACHE.get(fullkey)
                             if fullkey is not None else None)
+                full_hit = full_jit is not None
+                if fullkey is not None:
+                    # only real cache lookups count — an uncacheable
+                    # plan (no structural key) is not a miss
+                    _M_JIT.inc(cache="stream",
+                               result="hit" if full_hit else "miss")
                 if full_jit is None:
                     full_jit = jax.jit(run_full)
                     if fullkey is not None:
@@ -387,7 +640,8 @@ class Executor:
                                for sym, col in cur.assignments.items()},
                               raws[0].num_rows)
                 try:
-                    return full_jit(batch)
+                    return self._jit_call(full_jit, (batch,), "stream",
+                                          full_hit)
                 except (jax.errors.TracerArrayConversionError,
                         jax.errors.ConcretizationTypeError):
                     if fullkey is not None:
@@ -399,15 +653,19 @@ class Executor:
         # repeated query skips re-trace + executable reload (~2s/query
         # through the persistent-cache path, measured on the tunnel)
         run_jit = None
+        jit_hit = False
         if self.fragment_jit:
             if fkey is not None and fkey not in _STREAM_JIT_DENY:
                 run_jit = _STREAM_JIT_CACHE.get(fkey)
+                jit_hit = run_jit is not None
+                _M_JIT.inc(cache="stream",
+                           result="hit" if jit_hit else "miss")
             if run_jit is None and fkey not in _STREAM_JIT_DENY:
                 run_jit = jax.jit(run)
                 if fkey is not None:
                     _cache_put(_STREAM_JIT_CACHE, fkey, run_jit)
         for raw in (raws if raws is not None else
-                    (read_split_cached(conn, sp, columns)
+                    (self._read_split(conn, sp, columns)
                      for sp in splits)):
             batch = Batch({sym: raw.column(col)
                            for sym, col in cur.assignments.items()},
@@ -416,7 +674,9 @@ class Executor:
                 phys, post, _ = _lower_aggregates(node.aggregates, batch)
             if run_jit is not None:
                 try:
-                    out = run_jit(batch)
+                    out = self._jit_call(run_jit, (batch,), "stream",
+                                         jit_hit)
+                    jit_hit = True   # later splits reuse the program
                 except (jax.errors.TracerArrayConversionError,
                         jax.errors.ConcretizationTypeError):
                     run_jit = None
@@ -523,11 +783,13 @@ class Executor:
         if key in self._no_jit_chains:
             return run(base)
         jitted = self._jit_chains.get(key)
+        hit = jitted is not None
+        _M_JIT.inc(cache="masked", result="hit" if hit else "miss")
         if jitted is None:
             jitted = jax.jit(run)
             self._jit_chains[key] = jitted
         try:
-            return jitted(base)
+            return self._jit_call(jitted, (base,), "masked", hit)
         except (jax.errors.TracerArrayConversionError,
                 jax.errors.ConcretizationTypeError):
             # host-materializing expressions in the chain: run eagerly
@@ -552,6 +814,8 @@ class Executor:
         # per-executor (they can't outlive their plan objects safely).
         cache = _CHAIN_JIT_CACHE if structural else self._jit_chains
         jitted = cache.get(key)
+        hit = jitted is not None
+        _M_JIT.inc(cache="chain", result="hit" if hit else "miss")
         if jitted is None:
             helper = self._detached() if structural else self
 
@@ -564,7 +828,7 @@ class Executor:
                 _cache_put(_CHAIN_JIT_CACHE, key, jitted)
             else:
                 cache[key] = jitted
-        return jitted(base)
+        return self._jit_call(jitted, (base,), "chain", hit)
 
     # ------------------------------------------------------------------
     # leaves
@@ -582,7 +846,7 @@ class Executor:
                 from ..columnar import batch_from_pylist
                 return batch_from_pylist(
                     {s: [] for s in node.schema}, dict(node.schema))
-            batches = [read_split_cached(conn, s, columns)
+            batches = [self._read_split(conn, s, columns)
                        for s in mine]
             whole = (device_concat(batches) if len(batches) > 1
                      else batches[0])
@@ -609,7 +873,7 @@ class Executor:
                 self._reserve(int(est), len(columns),
                               f"table scan of {node.handle.table}")
             splits = conn.get_splits(node.handle, par)
-            batches = [read_split_cached(conn, s, columns)
+            batches = [self._read_split(conn, s, columns)
                        for s in splits]
             whole = (device_concat(batches) if len(batches) > 1
                      else batches[0])
@@ -898,9 +1162,13 @@ class Executor:
     def _reserve(self, rows: int, n_lanes: int, what: str) -> None:
         limit = int(self.session.get("query_max_memory_per_node"))
         try:
-            reserve_bytes(rows, n_lanes, limit, what)
+            est = reserve_bytes(rows, n_lanes, limit, what)
         except MemoryLimitExceeded as e:
             raise QueryError(str(e)) from e
+        # largest single reservation = the query's peak-memory figure
+        # reported in QueryCompletedEvent (capacity planning is the one
+        # allocation decision point in this engine — config.py)
+        self.peak_reserved_bytes = max(self.peak_reserved_bytes, est)
 
     def _oversized_join(self, probe: Batch, build: Batch, start, count,
                         eff, order, total: int, width: int,
@@ -949,7 +1217,11 @@ class Executor:
                 chunk_rows = out.num_rows_host()
                 if chunk_rows == 0:
                     continue
-            chunks.append(_to_host(out, chunk_rows))
+            spilled = _to_host(out, chunk_rows)
+            nbytes = sum(_col_bytes(c) for c in spilled.columns.values())
+            self.spilled_bytes += nbytes
+            _M_SPILL.inc(nbytes)
+            chunks.append(spilled)
         if not chunks:
             return _to_host(join_ops.expand_join(
                 probe, build, jnp.asarray(start),
@@ -1141,6 +1413,9 @@ class Executor:
         # this with all_to_all / all_gather over the device mesh)
         return self.execute(node.source)
 
+    def _exec__Pre(self, node: "_Pre") -> Batch:
+        return node.batch
+
     def _single_row(self, src: Batch) -> Batch:
         return _single_row(src)
 
@@ -1277,9 +1552,11 @@ def read_split_cached(conn, split, columns) -> Batch:
         missing = [c for c in columns
                    if entry is None or c not in entry["cols"]]
     if not missing:
+        _M_SCAN.inc(cache="split", result="hit")
         with _SCAN_CACHE_LOCK:
             return Batch({c: entry["cols"][c] for c in columns},
                          entry["num_rows"])
+    _M_SCAN.inc(cache="split", result="miss")
     raw = conn.read_split(split, missing)
     on_dev = jax.default_backend() != "cpu"
     if on_dev:
@@ -1308,6 +1585,9 @@ def read_split_cached(conn, split, columns) -> Batch:
                     entry["cols"][name] = col
                     state["bytes"] += _col_bytes(col)
         entry = state["entries"].get(skey)
+        _M_SCAN_BYTES.set(state["bytes"],
+                          connector=getattr(conn, "name",
+                                            type(conn).__name__))
         if entry is not None and all(c in entry["cols"]
                                      for c in columns):
             return Batch({c: entry["cols"][c] for c in columns},
@@ -1354,8 +1634,10 @@ def read_table_cached(conn, handle, columns, par) -> Optional[Batch]:
         missing = [c for c in columns
                    if entry is None or c not in entry["cols"]]
         if not missing:
+            _M_SCAN.inc(cache="table", result="hit")
             return Batch({c: entry["cols"][c] for c in columns},
                          entry["num_rows"])
+    _M_SCAN.inc(cache="table", result="miss")
     # cheap pre-check from the handle's row estimate so an over-budget
     # table (inventory@sf10 is ~4GB of lanes) is never transiently
     # materialized whole in HBM just to discover it doesn't fit. Sized
@@ -1407,6 +1689,9 @@ def read_table_cached(conn, handle, columns, par) -> Optional[Batch]:
             if name not in entry["cols"]:
                 entry["cols"][name] = col
                 state["bytes"] += _col_bytes(col)
+        _M_SCAN_BYTES.set(state["bytes"],
+                          connector=getattr(conn, "name",
+                                            type(conn).__name__))
         entry = state["entries"].get(wkey)
         if entry is not None and all(c in entry["cols"]
                                      for c in columns):

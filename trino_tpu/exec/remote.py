@@ -34,8 +34,8 @@ from ..plan.nodes import (Aggregate, AggregationNode, FilterNode,
 from ..plan.serde import to_jsonable
 from ..rex import InputRef
 from ..session import Session
-from .distributed import _Pre
-from .executor import Executor, QueryError, device_concat
+from .executor import (Executor, NodeStats, QueryError, _Pre,
+                       device_concat, merge_node_stats)
 
 # aggregate kinds a PARTIAL/FINAL split supports host-side, mapping to
 # the FINAL combine kind (reference: AggregationNode PARTIAL->FINAL +
@@ -94,13 +94,27 @@ class RemoteScheduler:
     coordinator combine)."""
 
     def __init__(self, worker_uris: List[str],
-                 catalogs: CatalogManager, session: Session):
+                 catalogs: CatalogManager, session: Session,
+                 collect_stats: bool = False):
         if not worker_uris:
             raise ValueError("RemoteScheduler needs at least one worker")
         from ..server.task_worker import RemoteTaskClient
         self.workers = [RemoteTaskClient(u) for u in worker_uris]
         self.catalogs = catalogs
         self.session = session
+        # distributed stats rollup: workers report per-node stats in
+        # task results; after execute_plan, fragment_stats[fid] holds
+        # the per-stage merge and self.stats the full rollup (fragment
+        # stages + the coordinator combine), powering EXPLAIN ANALYZE
+        self.collect_stats = collect_stats
+        self.fragment_stats: Dict[int, List[NodeStats]] = {}
+        self.fragment_workers: Dict[int, int] = {}
+        self.fragment_expected: int = 0     # tasks dispatched per frag
+        self.stats: List[NodeStats] = []
+        # cluster-wide resource figures: max of worker peaks (tasks run
+        # concurrently) + the coordinator combine; spill sums
+        self.peak_memory_bytes = 0
+        self.spill_bytes = 0
 
     # -- fragmentation -------------------------------------------------
     def _remotable(self, node: PlanNode) -> bool:
@@ -234,17 +248,48 @@ class RemoteScheduler:
 
     # -- dispatch ------------------------------------------------------
     def execute_plan(self, plan: PlanNode) -> Batch:
+        from ..obs.trace import null_span
+        trace = getattr(self.session, "trace", None)
+        sp = trace.span if trace is not None else null_span
         frags: List[_Fragment] = []
-        rewritten = self._cut(plan, frags)
+        with sp("schedule"):
+            rewritten = self._cut(plan, frags)
         if not frags:
-            ex = Executor(self.catalogs, self.session)
-            return ex.execute(plan)
+            ex = Executor(self.catalogs, self.session,
+                          self.collect_stats)
+            out = ex.execute(plan)
+            self.stats = list(ex.stats)
+            self.peak_memory_bytes = ex.peak_reserved_bytes
+            self.spill_bytes = ex.spilled_bytes
+            return out
         gathered = self._run_fragments(frags)
         final = _substitute(rewritten, {
             f.fid: f.final_builder(_Pre(gathered[f.fid]))
             for f in frags})
-        ex = Executor(self.catalogs, self.session)
-        return ex.execute(final)
+        ex = Executor(self.catalogs, self.session, self.collect_stats)
+        out = ex.execute(final)
+        self.peak_memory_bytes = max(self.peak_memory_bytes,
+                                     ex.peak_reserved_bytes)
+        self.spill_bytes += ex.spilled_bytes
+        if self.collect_stats:
+            # full rollup: fragment stages first (leaf-to-root order),
+            # annotated with their stage, then the coordinator combine
+            self.stats = []
+            for fid in sorted(self.fragment_stats):
+                nw = self.fragment_workers.get(fid, 0)
+                # a worker whose (best-effort) status fetch failed is
+                # missing from the merge: say so, or an under-counted
+                # rollup reads as a complete one
+                tag = (f"fragment {fid} x{nw} workers"
+                       if nw == self.fragment_expected else
+                       f"fragment {fid} x{nw}/"
+                       f"{self.fragment_expected} workers reported")
+                for s in self.fragment_stats[fid]:
+                    s.detail = f"{s.detail} {tag}".strip() \
+                        if s.detail else tag
+                    self.stats.append(s)
+            self.stats.extend(ex.stats)
+        return out
 
     def _run_fragments(self, frags: List[_Fragment]) -> Dict[int, Batch]:
         qid = uuid.uuid4().hex[:12]
@@ -257,11 +302,19 @@ class RemoteScheduler:
             nparts = min(nparts, hpc)
         results: Dict[int, List[Optional[Batch]]] = {
             f.fid: [None] * nparts for f in frags}
+        worker_stats: Dict[int, List[List[NodeStats]]] = {
+            f.fid: [] for f in frags}
+        worker_resources: List[Tuple[int, int]] = []  # (peak, spill)
         errors: List[str] = []
+        trace = getattr(session, "trace", None)
+        trace_parent = trace.current() if trace is not None else None
+        events = getattr(session, "events", None)
 
         payloads = {f.fid: to_jsonable(f.plan) for f in frags}
 
         def run_one(f: _Fragment, wi: int):
+            import time as _time
+            t0 = _time.perf_counter()
             try:
                 client = self.workers[wi]
                 tid = f"{qid}.{f.fid}.{wi}"
@@ -269,12 +322,44 @@ class RemoteScheduler:
                     tid, payloads[f.fid],
                     catalog=session.catalog, schema=session.schema,
                     part=wi, nparts=nparts,
-                    properties=dict(session.properties))
+                    properties=dict(session.properties),
+                    collect_stats=self.collect_stats)
                 pages = client.pages(
                     tid, cancel=getattr(session, "cancel", None))
                 results[f.fid][wi] = (device_concat(pages)
                                       if len(pages) > 1 else
                                       pages[0] if pages else None)
+                t1 = _time.perf_counter()
+                # telemetry is best-effort: the result pages are
+                # already in hand, so a failed stats fetch (transient
+                # status GET error, graft bug) must never fail the
+                # query that produced them
+                try:
+                    if self.collect_stats:
+                        status = client.status(tid)
+                        reported = [NodeStats.from_dict(d) for d in
+                                    status.get("nodeStats") or []]
+                        if reported:
+                            worker_stats[f.fid].append(reported)
+                        # list.append is atomic; sums happen after join
+                        worker_resources.append((
+                            int(status.get("peakMemoryBytes") or 0),
+                            int(status.get("spillBytes") or 0)))
+                        if trace is not None:
+                            sp = trace.record(
+                                f"fragment_{f.fid}_execute", t0, t1,
+                                parent=trace_parent, worker=wi,
+                                task=tid)
+                            trace.graft(sp, status.get("spans") or [])
+                    # a remote task IS this engine's split of work: its
+                    # completion is the SplitCompleted lifecycle event
+                    if events is not None:
+                        from ..server.events import SplitCompletedEvent
+                        events.split_completed(SplitCompletedEvent(
+                            getattr(session, "query_id", "") or qid,
+                            f"task:{tid}", t1 - t0))
+                except Exception:      # noqa: BLE001
+                    pass
             except Exception as e:     # noqa: BLE001
                 errors.append(f"task {f.fid}@worker{wi}: "
                               f"{type(e).__name__}: {e}")
@@ -288,6 +373,16 @@ class RemoteScheduler:
         if errors:
             raise QueryError("remote task failed: "
                              + "; ".join(errors[:3]))
+        if self.collect_stats:
+            self.fragment_expected = nparts
+            for f in frags:
+                self.fragment_stats[f.fid] = merge_node_stats(
+                    worker_stats[f.fid])
+                self.fragment_workers[f.fid] = len(worker_stats[f.fid])
+            for peak, spill in worker_resources:
+                self.peak_memory_bytes = max(self.peak_memory_bytes,
+                                             peak)
+                self.spill_bytes += spill
         out: Dict[int, Batch] = {}
         for f in frags:
             parts = [b for b in results[f.fid] if b is not None]
@@ -336,31 +431,83 @@ class DistributedHostQueryRunner:
     booting a coordinator + N workers on ephemeral ports)."""
 
     def __init__(self, worker_uris: List[str],
-                 session: Optional[Session] = None, catalogs=None):
+                 session: Optional[Session] = None, catalogs=None,
+                 collect_node_stats: bool = False):
         from ..runner import LocalQueryRunner
         self._local = LocalQueryRunner(session=session,
                                        catalogs=catalogs)
         self.session = self._local.session
         self.catalogs = self._local.catalogs
         self.worker_uris = list(worker_uris)
+        self.collect_node_stats = collect_node_stats
 
     def execute(self, sql: str):
+        import time as _time
+        from ..obs.metrics import QUERY_WALL_SECONDS
+        from ..obs.trace import QueryTrace, null_span
         from ..planner.logical import LogicalPlanner
         from ..planner.optimizer import optimize
+        from ..plan.nodes import plan_tree_lines
         from ..runner import QueryResult
         from ..sql import ast as A
         from ..sql.parser import parse_statement
+        from ..types import VARCHAR
+        t0 = _time.perf_counter()
         stmt = parse_statement(sql)
+        analyze = False
+        if isinstance(stmt, A.Explain):
+            if not stmt.analyze \
+                    or not isinstance(stmt.statement, A.QueryStatement):
+                return self._local.execute(sql)
+            # distributed EXPLAIN ANALYZE: run the inner query over the
+            # workers WITH stats so the rendering shows real per-
+            # fragment numbers, not coordinator-only timings
+            analyze = True
+            stmt = stmt.statement
         if not isinstance(stmt, A.QueryStatement):
             return self._local.execute(sql)   # DDL etc: coordinator-only
-        planner = LogicalPlanner(self.catalogs, self.session)
-        plan = optimize(planner.plan(stmt), self.catalogs, self.session)
-        sched = RemoteScheduler(self.worker_uris, self.catalogs,
-                                self.session)
-        batch = sched.execute_plan(plan)
+        collect = self.collect_node_stats or analyze
+        trace = (QueryTrace(getattr(self.session, "query_id", ""))
+                 if collect else None)
+        sp = trace.span if trace is not None else null_span
+        prev_trace = self.session.trace
+        self.session.trace = trace
+        try:
+            with sp("plan"):
+                planner = LogicalPlanner(self.catalogs, self.session)
+                plan = planner.plan(stmt)
+            with sp("optimize"):
+                plan = optimize(plan, self.catalogs, self.session)
+            sched = RemoteScheduler(
+                self.worker_uris, self.catalogs, self.session,
+                collect_stats=collect)
+            with sp("execute"):
+                batch = sched.execute_plan(plan)
+        finally:
+            self.session.trace = prev_trace
+            # same latency histogram LocalQueryRunner feeds, in the
+            # finally for the same reason: failed/timed-out queries
+            # must not vanish from the SLO dashboards
+            QUERY_WALL_SECONDS.observe(_time.perf_counter() - t0)
+        if analyze:
+            from .executor import render_analyze_lines
+            lines = render_analyze_lines(plan_tree_lines(plan),
+                                         sched.stats, trace)
+            res = QueryResult(["Query Plan"], [VARCHAR],
+                              [[l] for l in lines])
+            res.stats = sched.stats
+            res.trace = trace
+            return res
         schema = batch.schema()
         types = [schema[s] for s in plan.symbols]
-        return QueryResult(list(plan.names), types, batch.to_pylist())
+        res = QueryResult(list(plan.names), types, batch.to_pylist())
+        res.plan_lines = plan_tree_lines(plan)
+        res.trace = trace
+        res.peak_memory_bytes = sched.peak_memory_bytes
+        res.spill_bytes = sched.spill_bytes
+        if self.collect_node_stats:
+            res.stats = sched.stats
+        return res
 
 
 def _substitute(node: PlanNode, repl: Dict[int, PlanNode]) -> PlanNode:

@@ -1,0 +1,26 @@
+"""Telemetry subsystem: metrics registry + query trace spans.
+
+Reference parity: the reference treats observability as a first-class
+subsystem — always-on QueryStats/OperatorStats
+(operator/OperatorStats.java), JMX metrics exported per component
+(io.airlift.stats), and the /v1/query detail API feeding the web UI.
+Here the same three layers exist TPU-first:
+
+- ``obs.metrics``: process-wide counters/gauges/histograms with
+  Prometheus text exposition (GET /metrics on the coordinator and the
+  task worker) — the JMX/MBean analog.
+- ``obs.trace``: a per-query span tree (parse -> plan -> optimize ->
+  execute, with jit_trace vs device_execute children) — on a tensor
+  runtime compilation/dispatch overheads dominate (PAPERS.md "Query
+  Processing on Tensor Computation Runtimes"), so trace-vs-execute
+  separation is the single most important measurement the JVM engine
+  never needed.
+- rich ``NodeStats`` + the distributed rollup live with the executor
+  (exec/executor.py, exec/remote.py): workers report per-node stats in
+  task results and the coordinator merges them per stage.
+"""
+
+from .metrics import METRICS, MetricsRegistry
+from .trace import QueryTrace, Span
+
+__all__ = ["METRICS", "MetricsRegistry", "QueryTrace", "Span"]

@@ -1,0 +1,302 @@
+"""Process-wide metrics registry with Prometheus text exposition.
+
+Reference parity: the reference exports engine counters through JMX
+(io.airlift.stats CounterStat/DistributionStat on QueryManager,
+SqlTaskManager, the exchange clients) and the prometheus-jmx bridge.
+Here the registry is a small lock-safe process singleton (``METRICS``)
+rendered in the Prometheus text format (version 0.0.4) at GET /metrics
+on both the coordinator and the task worker.
+
+Design notes:
+- one ``threading.Lock`` per registry covers every mutation AND the
+  render pass; metric operations are dict updates, so the hot-path cost
+  is a lock acquire + float add (the executor increments these per
+  query, not per row — never inside a jitted program).
+- label support is positional-by-name: a metric declares its label
+  names once; every sample supplies them as keyword arguments. A
+  mismatched label set raises — silent label drift would corrupt the
+  time series.
+- gauges may also be fed by *collector callbacks* run at render time
+  (queue depth, cache residency): values that are cheap to read but
+  wasteful to push on every change.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+
+def _escape(v: object) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer() and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+class _Metric:
+    """Base: a named family of (label-tuple -> value) samples."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, labelnames: Sequence[str],
+                 lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self.labelnames = tuple(labelnames)
+        self._lock = lock
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} expects labels "
+                f"{self.labelnames}, got {tuple(labels)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+    def samples(self) -> List[Tuple[Tuple[str, ...], float]]:
+        with self._lock:
+            return sorted(self._values.items())
+
+    def _render_labels(self, key: Tuple[str, ...],
+                       extra: Sequence[Tuple[str, str]] = ()) -> str:
+        pairs = [f'{n}="{_escape(v)}"'
+                 for n, v in list(zip(self.labelnames, key)) + list(extra)]
+        return "{" + ",".join(pairs) + "}" if pairs else ""
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for key, v in self.samples():
+            lines.append(
+                f"{self.name}{self._render_labels(key)} {_fmt(v)}")
+        return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError("counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+
+# wall-time-oriented default buckets: 1ms .. ~2min
+DEFAULT_BUCKETS = (0.001, 0.005, 0.025, 0.1, 0.25, 0.5, 1.0, 2.5,
+                   5.0, 10.0, 30.0, 120.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help, labelnames, lock,
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help, labelnames, lock)
+        self.buckets = tuple(sorted(buckets))
+        # per label-key: [bucket counts..., +Inf count, sum]
+        self._hist: Dict[Tuple[str, ...], List[float]] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            h = self._hist.get(key)
+            if h is None:
+                h = [0.0] * (len(self.buckets) + 2)
+                self._hist[key] = h
+            for i, b in enumerate(self.buckets):
+                if value <= b:
+                    h[i] += 1
+            h[-2] += 1           # +Inf / count
+            h[-1] += value       # sum
+
+    def count(self, **labels) -> float:
+        with self._lock:
+            h = self._hist.get(self._key(labels))
+            return h[-2] if h else 0.0
+
+    def render(self) -> List[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            items = sorted(self._hist.items())
+        for key, h in items:
+            for i, b in enumerate(self.buckets):
+                lines.append(
+                    f"{self.name}_bucket"
+                    f"{self._render_labels(key, [('le', _fmt(b))])}"
+                    f" {_fmt(h[i])}")
+            lines.append(
+                f"{self.name}_bucket"
+                f"{self._render_labels(key, [('le', '+Inf')])}"
+                f" {_fmt(h[-2])}")
+            lines.append(
+                f"{self.name}_sum{self._render_labels(key)} "
+                f"{_fmt(h[-1])}")
+            lines.append(
+                f"{self.name}_count{self._render_labels(key)} "
+                f"{_fmt(h[-2])}")
+        return lines
+
+
+class MetricsRegistry:
+    """Named-metric registry; ``counter``/``gauge``/``histogram`` are
+    get-or-create (idempotent across modules instrumenting the same
+    family). ``render()`` produces the full text exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+        self._collectors: List[Callable[[], None]] = []
+
+    def _get(self, cls, name: str, help: str,
+             labelnames: Sequence[str], **kw) -> _Metric:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls:
+                    raise ValueError(
+                        f"metric {name} already registered as {m.kind}")
+                return m
+            m = cls(name, help, tuple(labelnames), self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get(Gauge, name, help, labelnames)
+
+    def histogram(self, name: str, help: str = "",
+                  labelnames: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._get(Histogram, name, help, labelnames,
+                         buckets=buckets)
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` before every render; it refreshes gauges whose
+        values are polled, not pushed (queue depth, cache bytes).
+        Pair with ``unregister_collector`` when the owning component
+        shuts down — the registry is process-global and would pin the
+        callback (and keep rendering its stale gauges) forever."""
+        with self._lock:
+            self._collectors.append(fn)
+
+    def unregister_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            try:
+                self._collectors.remove(fn)
+            except ValueError:
+                pass
+
+    def render(self) -> str:
+        with self._lock:
+            collectors = list(self._collectors)
+            metrics = list(self._metrics.values())
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:   # noqa: BLE001 — scrape must not fail
+                pass
+        lines: List[str] = []
+        for m in sorted(metrics, key=lambda m: m.name):
+            lines.extend(m.render())
+        return "\n".join(lines) + "\n"
+
+
+# the process-wide registry (the JMX MBean server analog)
+METRICS = MetricsRegistry()
+
+# shared across every runner flavor (LocalQueryRunner and the remote
+# DistributedHostQueryRunner feed the same latency histogram — one
+# definition so the help text and identity cannot drift)
+QUERY_WALL_SECONDS = METRICS.histogram(
+    "trino_tpu_query_wall_seconds",
+    "End-to-end query wall time through the runner")
+
+
+def write_exposition(handler) -> None:
+    """Serve METRICS as a Prometheus text response on a
+    BaseHTTPRequestHandler — the one /metrics implementation shared by
+    the coordinator and the task worker."""
+    raw = METRICS.render().encode()
+    handler.send_response(200)
+    handler.send_header("Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8")
+    handler.send_header("Content-Length", str(len(raw)))
+    handler.end_headers()
+    handler.wfile.write(raw)
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[Tuple[str, ...], float]]:
+    """Parse Prometheus text exposition back into
+    {metric_name: {(label=value, ...): value}} — the test-side decoder
+    (asserting on re-parsed samples, not on string formatting)."""
+    out: Dict[str, Dict[Tuple[str, ...], float]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        name_labels, _, raw = line.rpartition(" ")
+        if "{" in name_labels:
+            name, _, rest = name_labels.partition("{")
+            body = rest.rstrip("}")
+            labels = []
+            for part in _split_labels(body):
+                k, _, v = part.partition("=")
+                labels.append(f"{k}={v.strip(chr(34))}")
+            key = tuple(labels)
+        else:
+            name, key = name_labels, ()
+        out.setdefault(name, {})[key] = float(raw)
+    return out
+
+
+def _split_labels(body: str) -> List[str]:
+    parts, cur, inq = [], "", False
+    for ch in body:
+        if ch == '"':
+            inq = not inq
+            cur += ch
+        elif ch == "," and not inq:
+            parts.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur:
+        parts.append(cur)
+    return parts

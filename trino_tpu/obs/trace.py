@@ -1,0 +1,165 @@
+"""Per-query trace spans: a tree of timed phases.
+
+Reference parity: the reference records queryStats stage timings
+(QueryStateMachine's queued/analysis/planning/execution durations) and
+exposes them in /v1/query; OpenTelemetry spans landed on the same
+boundaries (io.opentelemetry.api wiring in DispatchManager /
+SqlQueryExecution). Here a ``QueryTrace`` rides on the Session: the
+runner opens parse/plan/optimize/execute spans, the executor nests
+jit_trace vs device_execute children under execute, and the remote
+scheduler grafts per-fragment subtrees reported by workers. On a tensor
+runtime this split is the headline number — compilation/dispatch
+dominates latency (PAPERS.md "Query Processing on Tensor Computation
+Runtimes"), and a wall-clock total cannot show it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class Span:
+    name: str
+    start_s: float                      # perf_counter at open
+    end_s: Optional[float] = None       # perf_counter at close
+    attrs: Dict[str, object] = field(default_factory=dict)
+    children: List["Span"] = field(default_factory=list)
+
+    @property
+    def wall_s(self) -> float:
+        return (self.end_s or self.start_s) - self.start_s
+
+    def to_dict(self, origin_s: float) -> dict:
+        d = {"name": self.name,
+             "startMillis": round((self.start_s - origin_s) * 1000, 3),
+             "wallMillis": round(self.wall_s * 1000, 3)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict(origin_s) for c in self.children]
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, origin_s: float = 0.0) -> "Span":
+        start = origin_s + d.get("startMillis", 0.0) / 1000.0
+        sp = cls(d.get("name", "?"), start,
+                 start + d.get("wallMillis", 0.0) / 1000.0,
+                 dict(d.get("attrs", {})))
+        sp.children = [cls.from_dict(c, origin_s)
+                       for c in d.get("children", [])]
+        return sp
+
+
+class QueryTrace:
+    """The span tree of one query. ``span(name)`` is a context manager
+    nesting under the innermost open span; ``record``/``graft`` attach
+    pre-timed spans (worker-reported subtrees arrive whole). The open-
+    span stack is owned by the query's executor thread; the lock only
+    guards child-list appends, which fragment-dispatch threads hit
+    concurrently."""
+
+    def __init__(self, query_id: str = ""):
+        self.query_id = query_id
+        self.origin_s = time.perf_counter()
+        self.roots: List[Span] = []
+        self._stack: List[Span] = []
+        self._lock = threading.Lock()
+
+    # -- structured construction --------------------------------------
+    def span(self, name: str, **attrs) -> "_SpanCtx":
+        return _SpanCtx(self, name, attrs)
+
+    def _open(self, name: str, attrs: Dict[str, object]) -> Span:
+        sp = Span(name, time.perf_counter(), attrs=dict(attrs))
+        with self._lock:
+            parent = self._stack[-1] if self._stack else None
+            (parent.children if parent else self.roots).append(sp)
+            self._stack.append(sp)
+        return sp
+
+    def _close(self, sp: Span) -> None:
+        sp.end_s = time.perf_counter()
+        with self._lock:
+            if self._stack and self._stack[-1] is sp:
+                self._stack.pop()
+
+    def current(self) -> Optional[Span]:
+        with self._lock:
+            return self._stack[-1] if self._stack else None
+
+    def record(self, name: str, start_s: float, end_s: float,
+               parent: Optional[Span] = None, **attrs) -> Span:
+        """Attach an already-timed span under ``parent`` (or the
+        innermost open span). Safe from fragment-dispatch threads."""
+        sp = Span(name, start_s, end_s, dict(attrs))
+        with self._lock:
+            if parent is None:
+                parent = self._stack[-1] if self._stack else None
+            (parent.children if parent else self.roots).append(sp)
+        return sp
+
+    def graft(self, parent: Optional[Span], spans: List[dict],
+              base_s: Optional[float] = None) -> None:
+        """Attach worker-reported span dicts (their clocks are not ours:
+        rebase the subtree at ``base_s``, default = parent start)."""
+        if parent is not None and base_s is None:
+            base_s = parent.start_s
+        for d in spans:
+            sp = Span.from_dict(d, base_s if base_s is not None
+                                else self.origin_s)
+            with self._lock:
+                (parent.children if parent is not None
+                 else self.roots).append(sp)
+
+    # -- rendering ------------------------------------------------------
+    def to_dicts(self) -> List[dict]:
+        return [r.to_dict(self.origin_s) for r in self.roots]
+
+    def lines(self) -> List[str]:
+        """Indented text rendering for EXPLAIN ANALYZE."""
+        out: List[str] = []
+
+        def walk(sp: Span, depth: int) -> None:
+            attrs = ""
+            if sp.attrs:
+                attrs = " " + ", ".join(
+                    f"{k}={v}" for k, v in sorted(sp.attrs.items()))
+            out.append(f"{'   ' * depth}- {sp.name}: "
+                       f"{sp.wall_s * 1000:.2f}ms{attrs}")
+            for c in sp.children:
+                walk(c, depth + 1)
+
+        for r in self.roots:
+            walk(r, 0)
+        return out
+
+
+def null_span(name: str, **attrs):
+    """Drop-in for ``QueryTrace.span`` when no trace is installed —
+    callers write ``sp = trace.span if trace else null_span`` and keep
+    one code path."""
+    from contextlib import nullcontext
+    return nullcontext()
+
+
+class _SpanCtx:
+    __slots__ = ("_trace", "_name", "_attrs", "span")
+
+    def __init__(self, trace: QueryTrace, name: str, attrs):
+        self._trace = trace
+        self._name = name
+        self._attrs = attrs
+        self.span: Optional[Span] = None
+
+    def __enter__(self) -> Span:
+        self.span = self._trace._open(self._name, self._attrs)
+        return self.span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc_type is not None and self.span is not None:
+            self.span.attrs.setdefault("error", exc_type.__name__)
+        self._trace._close(self.span)

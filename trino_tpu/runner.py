@@ -21,6 +21,7 @@ from .connectors.tpcds import TpcdsConnector
 from .connectors.tpch import TpchConnector
 from .exec import Executor, QueryError
 from .functions import list_functions
+from .obs.trace import null_span
 from .plan.nodes import OutputNode, plan_tree_lines
 from .planner import LogicalPlanner, PlanningError
 from .planner.optimizer import optimize
@@ -34,7 +35,9 @@ from .types import Type, VARCHAR, BIGINT, parse_type
 @dataclass
 class QueryResult:
     """Client-facing result (reference: client QueryResults payload,
-    Appendix B.1)."""
+    Appendix B.1) plus the telemetry captured while producing it
+    (per-node stats, the span tree, the plan rendering — the inputs of
+    /v1/query/{id} and EXPLAIN ANALYZE)."""
     columns: List[str]
     types: List[Type]
     rows: List[list]
@@ -42,6 +45,11 @@ class QueryResult:
     wall_s: float = 0.0
     update_type: Optional[str] = None
     update_count: Optional[int] = None
+    stats: Optional[list] = None            # List[NodeStats]
+    plan_lines: Optional[List[str]] = None  # captured at execution time
+    trace: Optional[object] = None          # obs.trace.QueryTrace
+    peak_memory_bytes: int = 0
+    spill_bytes: int = 0
 
     def __iter__(self):
         return iter(self.rows)
@@ -94,20 +102,46 @@ class LocalQueryRunner:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
+        from .obs.metrics import QUERY_WALL_SECONDS
+        from .obs.trace import QueryTrace
         t0 = time.perf_counter()
+        # tracing rides with stats collection: it is cheap but not
+        # free (a span per jitted dispatch), so the no-telemetry path
+        # must stay trace-less for _jit_call's early return to matter
+        trace = QueryTrace() if self.collect_node_stats else None
+        sp = trace.span if trace is not None else null_span
+        prev_trace = self.session.trace
+        self.session.trace = trace
         try:
-            stmt = parse_statement(sql)
-        except ParseError as e:
-            raise QueryError(f"SYNTAX_ERROR: {e}") from e
-        qid = self.session.next_query_id()
-        try:
-            result = self._dispatch(stmt, sql)
-        except PlanningError as e:
-            raise QueryError(str(e)) from e
-        except KeyError as e:
-            raise QueryError(str(e).strip('"')) from e
+            try:
+                with sp("parse"):
+                    stmt = parse_statement(sql)
+            except ParseError as e:
+                raise QueryError(f"SYNTAX_ERROR: {e}") from e
+            # a coordinator-stamped id (QueryTracker.submit) wins so
+            # split events and spans correlate with /v1/query entries;
+            # it is consumed here — a reused standalone session mints a
+            # fresh runner-local id per query
+            qid = self.session.query_id or self.session.next_query_id()
+            self.session.query_id = qid
+            if trace is not None:
+                trace.query_id = qid
+            try:
+                result = self._dispatch(stmt, sql)
+            except PlanningError as e:
+                raise QueryError(str(e)) from e
+            except KeyError as e:
+                raise QueryError(str(e).strip('"')) from e
+        finally:
+            self.session.trace = prev_trace
+            self.session.query_id = ""
+            # observed for failed/canceled queries too — the slowest
+            # queries are exactly the ones that time out, and a latency
+            # histogram that drops them reads optimistic at p99
+            QUERY_WALL_SECONDS.observe(time.perf_counter() - t0)
         result.query_id = qid
         result.wall_s = time.perf_counter() - t0
+        result.trace = trace
         return result
 
     # ------------------------------------------------------------------
@@ -321,36 +355,58 @@ class LocalQueryRunner:
     # ------------------------------------------------------------------
     def _run_query(self, stmt: A.QueryStatement,
                    collect_stats: bool = False):
-        planner = LogicalPlanner(self.catalogs, self.session)
-        plan = planner.plan(stmt)
-        plan = optimize(plan, self.catalogs, self.session)
+        trace = self.session.trace
+        sp = (trace.span if trace is not None else null_span)
+        with sp("plan"):
+            planner = LogicalPlanner(self.catalogs, self.session)
+            plan = planner.plan(stmt)
+        with sp("optimize"):
+            plan = optimize(plan, self.catalogs, self.session)
         ex = self._make_executor(collect_stats)
-        batch = ex.execute(plan)
+        with sp("execute"):
+            batch = ex.execute(plan)
         schema = batch.schema()
         types = [schema[s] for s in plan.symbols]
         rows = batch.to_pylist()
         result = QueryResult(list(plan.names), types, rows)
+        # the rendering /v1/query/{id} serves — captured HERE so the
+        # detail endpoint never re-plans the query (and never silently
+        # diverges from what actually ran)
+        result.plan_lines = plan_tree_lines(plan)
+        result.peak_memory_bytes = getattr(ex, "peak_reserved_bytes", 0)
+        result.spill_bytes = getattr(ex, "spilled_bytes", 0)
         if collect_stats:
-            result.stats = ex.stats  # type: ignore[attr-defined]
+            result.stats = ex.stats
         return result
 
     def _explain(self, stmt: A.Explain) -> QueryResult:
+        from .exec.executor import render_analyze_lines
         inner = stmt.statement
         if not isinstance(inner, A.QueryStatement):
             raise QueryError("EXPLAIN supports queries only")
+        if stmt.analyze:
+            # EXPLAIN ANALYZE always traces, even on a runner whose
+            # normal queries don't collect telemetry. The rendered plan
+            # is the one _run_query captured — the plan that actually
+            # ran, with no second plan+optimize pass
+            trace = self.session.trace
+            owned = trace is None
+            if owned:
+                from .obs.trace import QueryTrace
+                trace = QueryTrace(self.session.query_id)
+                self.session.trace = trace
+            try:
+                res = self._run_query(inner, collect_stats=True)
+            finally:
+                if owned:
+                    self.session.trace = None
+            lines = render_analyze_lines(res.plan_lines, res.stats,
+                                         trace)
+            return QueryResult(["Query Plan"], [VARCHAR],
+                               [[l] for l in lines])
         planner = LogicalPlanner(self.catalogs, self.session)
         plan = optimize(planner.plan(inner), self.catalogs,
                         self.session)
-        if stmt.analyze:
-            res = self._run_query(inner, collect_stats=True)
-            lines = plan_tree_lines(plan)
-            lines.append("")
-            for s in getattr(res, "stats", []):
-                lines.append(
-                    f"{s.name}: {s.wall_s*1000:.2f}ms, "
-                    f"{s.output_rows} rows")
-            return QueryResult(["Query Plan"], [VARCHAR],
-                               [[l] for l in lines])
         return QueryResult(["Query Plan"], [VARCHAR],
                            [[l] for l in plan_tree_lines(plan)])
 

@@ -28,10 +28,21 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
+from ..obs.metrics import METRICS
 from ..runner import LocalQueryRunner, QueryResult
 from ..session import Session
 
 PAGE_ROWS = 4096     # rows per QueryResults page
+
+# query lifecycle counters (reference: QueryManager JMX stats). One
+# increment per state ENTERED, so rates and totals are both readable.
+_M_STATES = METRICS.counter(
+    "trino_tpu_query_states_total",
+    "Query state transitions by state entered", ("state",))
+_M_DETAIL_PLAN_ERRORS = METRICS.counter(
+    "trino_tpu_query_detail_plan_errors_total",
+    "Failures re-deriving a plan for /v1/query/{id} (legacy fallback "
+    "path; the plan is normally captured at execution time)")
 
 
 def _json_value(v):
@@ -137,8 +148,14 @@ class QueryTracker:
                f"_{next(self._counter):05d}")
         q = _Query(qid, uuid.uuid4().hex[:16], sql, session)
         q.source = source
+        # stamp the session so the executor's split-completion path and
+        # the trace spans carry the coordinator query id and can fan
+        # out SplitCompletedEvents through this tracker's listeners
+        session.query_id = qid
+        session.events = self.events
         with self._lock:
             self._queries[qid] = q
+        _M_STATES.inc(state="QUEUED")
         self.events.query_created(QueryCreatedEvent(
             qid, sql, session.user, session.catalog, session.schema))
 
@@ -152,6 +169,7 @@ class QueryTracker:
                 timer = threading.Timer(limit, q.do_cancel)
                 timer.daemon = True
                 timer.start()
+            _M_STATES.inc(state="RUNNING")
             try:
                 q.run(self._make_runner)
             finally:
@@ -159,12 +177,33 @@ class QueryTracker:
                     timer.cancel()
                 if q.group is not None and self.groups is not None:
                     self.groups.query_finished(q.group)
+                _M_STATES.inc(state=q.state)
+                r = q.result
+                stats = (getattr(r, "stats", None) or []) if r else []
+                cum = None
+                if stats:
+                    cum = {
+                        "input_rows": sum(max(s.input_rows, 0)
+                                          for s in stats),
+                        "output_rows": sum(max(s.output_rows, 0)
+                                           for s in stats),
+                        "output_bytes": sum(max(s.output_bytes, 0)
+                                            for s in stats),
+                        "compile_s": sum(s.compile_s for s in stats),
+                        "wall_s": sum(s.wall_s for s in stats),
+                    }
                 self.events.query_completed(QueryCompletedEvent(
                     q.query_id, q.sql, q.session.user, q.state,
                     time.time() - q.created,
-                    rows=len(q.result.rows) if q.result else 0,
+                    rows=len(r.rows) if r else 0,
                     error_name=(q.error or {}).get("errorName"),
-                    error_message=(q.error or {}).get("message")))
+                    error_message=(q.error or {}).get("message"),
+                    peak_memory_bytes=getattr(
+                        r, "peak_memory_bytes", 0) if r else 0,
+                    spill_bytes=getattr(r, "spill_bytes", 0) if r else 0,
+                    cumulative_operator_stats=cum,
+                    operator_summaries=tuple(
+                        s.to_dict() for s in stats)))
 
         def start(group=None):
             # the group is recorded BEFORE the thread exists so a
@@ -252,7 +291,8 @@ class Coordinator:
             if live:
                 from ..exec.remote import DistributedHostQueryRunner
                 return DistributedHostQueryRunner(
-                    live, session=session, catalogs=self._catalogs)
+                    live, session=session, catalogs=self._catalogs,
+                    collect_node_stats=True)
             # per-node wall/row stats feed the web UI's query detail
             # (OperatorStats is always-on in the reference coordinator)
             return LocalQueryRunner(session=session,
@@ -266,10 +306,47 @@ class Coordinator:
         self.resource_groups = resource_groups
         self.tracker = QueryTracker(make_runner, events,
                                     resource_groups)
+        self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
         self.port = self._httpd.server_address[1]
         self._thread: Optional[threading.Thread] = None
+
+    def _register_metric_collectors(self):
+        """Polled gauges refreshed at scrape time (obs/metrics.py):
+        query states and queue depth. The registry is process-global:
+        the collector is unregistered on stop() (and self-unregisters
+        if the coordinator is garbage-collected without stop), so test
+        suites building many coordinators don't accumulate dead
+        callbacks or stale gauges. With several LIVE coordinators in
+        one process the gauge families are shared and last-writer-wins
+        — production runs one coordinator per process."""
+        import weakref
+        wself = weakref.ref(self)
+        g_state = METRICS.gauge(
+            "trino_tpu_queries",
+            "Queries currently tracked, by state", ("state",))
+        g_queue = METRICS.gauge(
+            "trino_tpu_queue_depth",
+            "Queries admitted but not yet running (queue depth)")
+        g_workers = METRICS.gauge(
+            "trino_tpu_active_workers", "Known worker nodes")
+
+        def collect():
+            co = wself()
+            if co is None:
+                METRICS.unregister_collector(collect)
+                return
+            qs = co.tracker.all()
+            for st in ("QUEUED", "RUNNING", "FINISHED", "FAILED",
+                       "CANCELED"):
+                g_state.set(sum(1 for q in qs if q.state == st),
+                            state=st)
+            g_queue.set(sum(1 for q in qs if q.state == "QUEUED"))
+            g_workers.set(len(co.workers))
+
+        self._metric_collector = collect
+        METRICS.register_collector(collect)
 
     @property
     def base_uri(self) -> str:
@@ -282,6 +359,7 @@ class Coordinator:
         return self
 
     def stop(self):
+        METRICS.unregister_collector(self._metric_collector)
         self._httpd.shutdown()
 
     # ---- resource payloads -------------------------------------------
@@ -354,14 +432,35 @@ class Coordinator:
             out["rows"] = len(q.result.rows)
             out["wallMillis"] = int(
                 (getattr(q.result, "wall_s", 0.0) or 0.0) * 1000)
+            out["peakMemoryBytes"] = getattr(
+                q.result, "peak_memory_bytes", 0)
+            out["spillBytes"] = getattr(q.result, "spill_bytes", 0)
             stats = getattr(q.result, "stats", None)
             if stats:
                 out["nodeStats"] = [
                     {"node": s.name, "detail": s.detail,
                      "wallMillis": round(s.wall_s * 1000, 2),
-                     "outputRows": s.output_rows} for s in stats]
-        plan = getattr(q, "_plan_lines", None)
+                     "outputRows": s.output_rows,
+                     "inputRows": s.input_rows,
+                     "inputBytes": s.input_bytes,
+                     "outputBytes": s.output_bytes,
+                     "compileMillis": round(s.compile_s * 1000, 2),
+                     "cacheHit": s.cache_hit} for s in stats]
+            trace = getattr(q.result, "trace", None)
+            if trace is not None and trace.roots:
+                out["spans"] = trace.to_dicts()
+        # the plan captured at execution time (QueryResult.plan_lines) —
+        # re-planning on every GET both wasted work and could silently
+        # diverge from the plan that actually ran. Checked BEFORE the
+        # mid-flight fallback cache, which a poll during RUNNING may
+        # have populated with a re-derived (possibly divergent) plan.
+        plan = (getattr(q.result, "plan_lines", None)
+                if q.result is not None else None)
+        if plan is None:
+            plan = getattr(q, "_plan_lines", None)
         if plan is None and q.state in ("FINISHED", "RUNNING"):
+            # legacy fallback (old results without captured plans, or a
+            # query mid-flight): derive once and cache on the query
             try:
                 from ..planner.logical import LogicalPlanner
                 from ..planner.optimizer import optimize
@@ -377,7 +476,9 @@ class Coordinator:
                     plan = plan_tree_lines(p)
                 else:
                     plan = []
-            except Exception:       # noqa: BLE001 — detail is best-effort
+            except Exception as e:  # noqa: BLE001 — detail is best-effort
+                _M_DETAIL_PLAN_ERRORS.inc()
+                out["planError"] = f"{type(e).__name__}: {e}"
                 plan = []
             q._plan_lines = plan
         if plan:
@@ -640,6 +741,10 @@ def _make_handler(co: Coordinator):
                 return
             path = urlparse(self.path).path
             parts = [p for p in path.split("/") if p]
+            if path == "/metrics":
+                from ..obs.metrics import write_exposition
+                write_exposition(self)
+                return
             if path == "/ui" or path == "/ui/":
                 self._send_html(_UI_PAGE)
                 return

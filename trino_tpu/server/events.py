@@ -27,7 +27,10 @@ class QueryCreatedEvent:
 
 @dataclass(frozen=True)
 class QueryCompletedEvent:
-    """spi/eventlistener/QueryCompletedEvent.java"""
+    """spi/eventlistener/QueryCompletedEvent.java — including the
+    QueryStatistics block (peakUserMemoryBytes, spilledBytes,
+    operatorSummaries) so listeners can act as an audit/accounting
+    sink, not just a lifecycle log."""
     query_id: str
     sql: str
     user: str
@@ -37,6 +40,14 @@ class QueryCompletedEvent:
     error_name: Optional[str] = None
     error_message: Optional[str] = None
     end_time: float = field(default_factory=time.time)
+    # QueryStatistics analog (spi/eventlistener/QueryStatistics.java)
+    peak_memory_bytes: int = 0
+    spill_bytes: int = 0
+    # cumulative operator flow: {"input_rows", "output_rows",
+    # "output_bytes", "compile_s", "wall_s"} summed over NodeStats
+    cumulative_operator_stats: Optional[dict] = None
+    # per-operator summaries, one dict per plan node (NodeStats.to_dict)
+    operator_summaries: tuple = ()
 
 
 @dataclass(frozen=True)

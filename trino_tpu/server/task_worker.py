@@ -26,9 +26,23 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..columnar import Batch, Column
+from ..obs.metrics import METRICS
 from ..serde import deserialize_batch, serialize_batch
 
 PAGE_ROWS = 1 << 16
+
+# exchange data-plane metrics (reference: ExchangeClient /
+# ExchangeOperator JMX stats); "sent" counts frames buffered by this
+# worker, "received" counts frames pulled by this process's clients
+_M_PAGES = METRICS.counter(
+    "trino_tpu_exchange_pages_total",
+    "Exchange page frames by direction", ("direction",))
+_M_PAGE_BYTES = METRICS.counter(
+    "trino_tpu_exchange_bytes_total",
+    "Serialized exchange bytes by direction", ("direction",))
+_M_TASKS = METRICS.counter(
+    "trino_tpu_worker_tasks_total",
+    "Tasks executed by this worker, by terminal state", ("state",))
 
 
 def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
@@ -53,12 +67,17 @@ def paginate(b: Batch, page_rows: int = PAGE_ROWS,
     exchange_compression session property passes CODEC_STORE."""
     n = b.num_rows_host()
     if n == 0:
-        return [serialize_batch(_slice_batch(b, 0, 0), codec=codec)]
-    if any(c.elements is not None for c in b.columns.values()):
-        return [serialize_batch(_slice_batch(b, 0, n), codec=codec)]
-    return [serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)),
+        frames = [serialize_batch(_slice_batch(b, 0, 0), codec=codec)]
+    elif any(c.elements is not None for c in b.columns.values()):
+        frames = [serialize_batch(_slice_batch(b, 0, n), codec=codec)]
+    else:
+        frames = [
+            serialize_batch(_slice_batch(b, lo, min(lo + page_rows, n)),
                             codec=codec)
             for lo in range(0, n, page_rows)]
+    _M_PAGES.inc(len(frames), direction="sent")
+    _M_PAGE_BYTES.inc(sum(len(f) for f in frames), direction="sent")
+    return frames
 
 
 class _Task:
@@ -70,6 +89,10 @@ class _Task:
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.pages: List[bytes] = []
+        self.node_stats: List[dict] = []   # NodeStats.to_dict per node
+        self.spans: List[dict] = []        # worker-local span tree
+        self.peak_memory_bytes = 0
+        self.spill_bytes = 0
         self.done = threading.Event()
 
     def run(self, payload: dict):
@@ -80,18 +103,35 @@ class _Task:
                               schema=payload.get("schema"))
             for name, value in payload.get("properties", {}).items():
                 session.set(name, value)
+            # per-node stats + spans ride back in the task status (the
+            # reference's TaskStatus/TaskStats carrying OperatorStats
+            # to the coordinator for the stage rollup)
+            collect = bool(payload.get("collect_stats"))
             if "fragment" in payload:
                 # serialized PlanFragment + split share — the remote
                 # task path (reference: SqlTaskManager.java:370-403
                 # executing a TaskUpdateRequest's fragment)
                 from ..exec.executor import Executor
+                from ..obs.trace import QueryTrace
                 from ..plan.serde import from_jsonable
                 runner = LocalQueryRunner(session=session)
                 plan = from_jsonable(payload["fragment"])
-                ex = Executor(runner.catalogs, session)
+                trace = QueryTrace(self.task_id) if collect else None
+                session.trace = trace
+                ex = Executor(runner.catalogs, session,
+                              collect_stats=collect)
                 ex.scan_partition = (int(payload["part"]),
                                      int(payload["nparts"]))
-                res = ex.execute(plan)
+                if trace is not None:
+                    with trace.span("task_execute",
+                                    task=self.task_id):
+                        res = ex.execute(plan)
+                    self.spans = trace.to_dicts()
+                else:
+                    res = ex.execute(plan)
+                self.node_stats = [s.to_dict() for s in ex.stats]
+                self.peak_memory_bytes = ex.peak_reserved_bytes
+                self.spill_bytes = ex.spilled_bytes
             else:
                 runner = LocalQueryRunner(session=session)
                 res = runner.execute_batch(payload["sql"])
@@ -105,6 +145,7 @@ class _Task:
             self.state = "FAILED"
             self.error = f"{type(e).__name__}: {e}"
         finally:
+            _M_TASKS.inc(state=self.state)
             self.done.set()
 
 
@@ -180,20 +221,30 @@ class TaskWorkerServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
-                # /v1/task/{id} -> status
+                # /v1/task/{id} -> status (incl. the worker-side
+                # operator stats + span tree for the stage rollup)
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     t = worker.get_task(parts[2])
                     if t is None:
                         self.send_error(404)
                         return
-                    body = json.dumps({"taskId": t.task_id,
-                                       "state": t.state,
-                                       "error": t.error}).encode()
+                    body = json.dumps(
+                        {"taskId": t.task_id,
+                         "state": t.state,
+                         "error": t.error,
+                         "nodeStats": t.node_stats,
+                         "spans": t.spans,
+                         "peakMemoryBytes": t.peak_memory_bytes,
+                         "spillBytes": t.spill_bytes}).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
+                    return
+                if self.path.split("?")[0] == "/metrics":
+                    from ..obs.metrics import write_exposition
+                    write_exposition(self)
                     return
                 self.send_error(404)
 
@@ -311,13 +362,22 @@ class RemoteTaskClient:
     def submit_fragment(self, task_id: str, fragment: dict,
                         catalog: str, schema: str, part: int,
                         nparts: int,
-                        properties: Optional[dict] = None):
+                        properties: Optional[dict] = None,
+                        collect_stats: bool = False):
         """POST a serialized plan fragment + split share (the
         HttpRemoteTask TaskUpdateRequest analog)."""
         return self._post(task_id, {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
+            "collect_stats": collect_stats,
             "properties": properties or {}})
+
+    def status(self, task_id: str) -> dict:
+        """GET the task status JSON, including worker-reported
+        nodeStats and spans once the task finished."""
+        with urllib.request.urlopen(
+                f"{self.base_uri}/v1/task/{task_id}", timeout=30) as r:
+            return json.loads(r.read())
 
     def _post(self, task_id: str, body: dict):
         payload = json.dumps(body).encode()
@@ -361,6 +421,8 @@ class RemoteTaskClient:
                 body = r.read()
             if complete:
                 break
+            _M_PAGES.inc(direction="received")
+            _M_PAGE_BYTES.inc(len(body), direction="received")
             out.append(deserialize_batch(body))
             token += 1
         return out

@@ -65,6 +65,16 @@ class Session:
     # PREPARE name FROM stmt registry (reference: Session.java
     # preparedStatements + execution/PrepareTask.java)
     prepared: Dict[str, object] = field(default_factory=dict)
+    # telemetry (obs/): the current query's span tree — the runner
+    # installs one per query; the executor nests jit_trace /
+    # device_execute children under the open execute span
+    trace: Optional[object] = None
+    # event fan-out (server/events.py EventListenerManager): when set,
+    # the executor fires SplitCompletedEvents from the split-read path
+    events: Optional[object] = None
+    # id of the query currently executing on this session (stamped by
+    # the coordinator / runner; carried into events and spans)
+    query_id: str = ""
 
     def get(self, name: str):
         if name in self.properties:
