@@ -199,6 +199,92 @@ def _leg_telemetry(schema: str, iters: int) -> float:
     return max(on / off - 1.0, 0.0)
 
 
+def _leg_fault(iters: int) -> dict:
+    """Fault-tolerant execution recovery overhead: the SAME distributed
+    query through two in-process workers, 0 vs 1 injected worker
+    failure (a stub that 500s every results pull), retry_policy=TASK.
+    The fractional slowdown is the price of a mid-query worker death;
+    the dict also carries the scrape-side artifacts (task-retry counter
+    + per-query peak-memory gauge) so the leg proves /metrics exposes
+    them."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    import trino_tpu  # noqa: F401
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    from trino_tpu.obs.metrics import METRICS
+    from trino_tpu.server.task_worker import TaskWorkerServer
+    from trino_tpu.session import Session
+
+    sql = ("SELECT l_returnflag, l_linestatus, sum(l_quantity), "
+           "count(*) FROM lineitem GROUP BY l_returnflag, "
+           "l_linestatus ORDER BY l_returnflag, l_linestatus")
+
+    class _DeadHandler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            body = b'{"taskId": "x", "state": "RUNNING"}'
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            self.send_error(500, "injected worker failure")
+
+        def do_DELETE(self):
+            self.send_response(204)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    # 3 workers in BOTH runs so nparts (and the per-worker split
+    # share) is identical — the fault run swaps one good worker for
+    # the dead stub, isolating recovery cost from fan-out changes
+    workers = [TaskWorkerServer().start() for _ in range(3)]
+    dead = ThreadingHTTPServer(("127.0.0.1", 0), _DeadHandler)
+    threading.Thread(target=dead.serve_forever, daemon=True).start()
+    dead_uri = f"http://127.0.0.1:{dead.server_address[1]}"
+
+    def make_session():
+        s = Session(catalog="tpch", schema="tiny")
+        s.set("retry_policy", "TASK")
+        s.set("retry_initial_delay_ms", 10)
+        return s
+
+    def best_of(uris):
+        # collect_node_stats so workers report peakMemoryBytes and the
+        # per-query gauge this leg advertises carries a real value
+        r = DistributedHostQueryRunner(uris, session=make_session(),
+                                       collect_node_stats=True)
+        r.execute(sql)       # compile + warm (and first retries)
+        b = float("inf")
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            r.execute(sql)
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    try:
+        good = [w.base_uri for w in workers]
+        t_ok = best_of(good)
+        t_fault = best_of([dead_uri] + good[:2])
+    finally:
+        dead.shutdown()
+        for w in workers:
+            w.stop()
+    return {
+        "overhead": max(t_fault / t_ok - 1.0, 0.0),
+        "task_retries_total":
+            METRICS.counter("trino_tpu_task_retries_total").value(),
+        "query_peak_memory_bytes":
+            METRICS.gauge("trino_tpu_query_peak_memory_bytes").value(),
+    }
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -215,12 +301,16 @@ def _run_probe_body(kind: str):
     else:
         legs = [("engine", lambda: _leg_engine("sf1", 2)),
                 ("micro", lambda: _leg_micro(0.1, 2)),
-                ("telemetry", lambda: _leg_telemetry("sf1", 2))]
+                ("telemetry", lambda: _leg_telemetry("sf1", 2)),
+                ("fault", lambda: _leg_fault(2))]
     for name, fn in legs:
         try:
             if name == "telemetry":
                 print(json.dumps(
                     {"leg": name, "overhead": fn()}), flush=True)
+            elif name == "fault":
+                print(json.dumps(dict({"leg": name}, **fn())),
+                      flush=True)
             else:
                 print(json.dumps({"leg": name, "rows_per_sec": fn()}),
                       flush=True)
@@ -269,12 +359,18 @@ def _probe(kind: str, timeout: float):
             vals[d.get("leg", "?")] = d["rows_per_sec"]
         elif "overhead" in d:
             vals[d.get("leg", "?")] = d["overhead"]
+            # fault leg ride-alongs: scrape-side FTE artifacts
+            if "task_retries_total" in d:
+                vals["task_retries"] = d["task_retries_total"]
+            if "query_peak_memory_bytes" in d:
+                vals["peak_memory_bytes"] = d["query_peak_memory_bytes"]
         elif "error" in d:
             errs[d.get("leg", "?")] = d["error"]
     if err_note:
         errs.setdefault("probe", err_note)
     expected = ("q18",) if kind == "scale" else \
-        ("engine", "micro", "telemetry")
+        ("engine", "micro", "telemetry") + \
+        (("fault",) if kind == "cpu" else ())
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -368,6 +464,16 @@ def main():
         "telemetry_overhead": round(
             dev_vals.get("telemetry",
                          cpu_vals.get("telemetry", 0.0)) or 0.0, 4),
+        # fault-tolerant execution (trino_tpu/fte/): fractional
+        # slowdown of the same distributed query with one injected
+        # worker failure under retry_policy=TASK, plus the scrape-side
+        # artifacts the leg drove (task retries, peak-memory gauge)
+        "fault_recovery_overhead": round(
+            cpu_vals.get("fault", 0.0) or 0.0, 4),
+        "fault_task_retries": round(
+            cpu_vals.get("task_retries", 0.0) or 0.0, 1),
+        "query_peak_memory_bytes": round(
+            cpu_vals.get("peak_memory_bytes", 0.0) or 0.0, 1),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale. sf100 lineitem

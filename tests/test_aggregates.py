@@ -215,6 +215,7 @@ def dist_runner():
     return LocalQueryRunner(distributed=True, n_devices=8)
 
 
+@pytest.mark.slow
 def test_distributed_nondecomposable_grouped(runner, dist_runner):
     sql = ("SELECT l_partkey % 5, count(DISTINCT l_quantity), "
            "min_by(l_orderkey, l_extendedprice), "
@@ -223,6 +224,7 @@ def test_distributed_nondecomposable_grouped(runner, dist_runner):
     assert q(dist_runner, sql) == q(runner, sql)
 
 
+@pytest.mark.slow
 def test_distributed_nondecomposable_global(runner, dist_runner):
     sql = ("SELECT count(DISTINCT l_suppkey), "
            "max_by(l_orderkey, l_extendedprice) "

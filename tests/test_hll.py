@@ -113,6 +113,7 @@ def test_approx_distinct_strings(runner):
     assert approx == 7
 
 
+@pytest.mark.slow
 def test_hll_distributed_matches_local(runner):
     sql = ("SELECT l_returnflag, cardinality(approx_set(l_partkey)) "
            "FROM lineitem GROUP BY l_returnflag ORDER BY l_returnflag")

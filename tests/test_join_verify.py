@@ -85,6 +85,7 @@ def test_float_single_key_join(weak_hash):
     assert len(res.rows) == 1 and res.rows[0][1] == "m"
 
 
+@pytest.mark.slow
 def test_distributed_partitioned_multikey(weak_hash):
     dist = LocalQueryRunner(distributed=True, n_devices=8)
     dist.execute("SET SESSION join_distribution_type = 'PARTITIONED'")

@@ -30,6 +30,7 @@ def test_rows_match_tolerance_and_order():
     assert rows_match([[None]], [[1]])
 
 
+@pytest.mark.slow
 def test_verifier_local_vs_distributed():
     control = LocalQueryRunner()
     test = LocalQueryRunner(distributed=True)

@@ -660,6 +660,7 @@ def test_q64_relaxed_nonempty(local, oracle):
     assert_rows_equal(got, want, "q64-relaxed", ordered=True)
 
 
+@pytest.mark.slow
 def test_q64_distributed_matches_local(local):
     dist = LocalQueryRunner(
         session=Session(catalog="tpcds", schema="tiny"),

@@ -156,6 +156,7 @@ _DIST_QUERIES = (sorted(TPCH_QUERIES)
                  else list(_DIST_DEFAULT))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("qn", _DIST_QUERIES)
 def test_tpch_distributed_matches_local(local, dist, qn):
     lres = [norm_row(r) for r in local.execute(TPCH_QUERIES[qn]).rows]
@@ -167,6 +168,7 @@ def test_tpch_distributed_matches_local(local, dist, qn):
 # tier 3: PARTITIONED join distribution == local
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_partitioned_join_matches_local(local):
     """Forced-PARTITIONED joins repartition both sides by key hash and
     join shard-locally (DetermineJoinDistributionType PARTITIONED

@@ -28,6 +28,10 @@ from typing import Dict, List, Optional, Tuple
 
 from ..catalog import CatalogManager
 from ..columnar import Batch
+from ..fte.retry import (TASK_RETRIES, RetryController, RetryPolicy,
+                         backoff_delay, pick_worker)
+from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
+                             StragglerDetector)
 from ..plan.nodes import (Aggregate, AggregationNode, FilterNode,
                           LimitNode, OutputNode, PlanNode, ProjectNode,
                           TableScanNode, TopNNode)
@@ -95,7 +99,8 @@ class RemoteScheduler:
 
     def __init__(self, worker_uris: List[str],
                  catalogs: CatalogManager, session: Session,
-                 collect_stats: bool = False):
+                 collect_stats: bool = False,
+                 failure_detector=None, spool=None):
         if not worker_uris:
             raise ValueError("RemoteScheduler needs at least one worker")
         from ..server.task_worker import RemoteTaskClient
@@ -115,6 +120,19 @@ class RemoteScheduler:
         # concurrently) + the coordinator combine; spill sums
         self.peak_memory_bytes = 0
         self.spill_bytes = 0
+        # fault-tolerant execution (trino_tpu/fte/): the heartbeat
+        # detector receives observed task failures and is consulted
+        # when picking a replacement worker; the spool receives every
+        # completed attempt's page frames (first-commit-wins) and is
+        # what the combine reads. Workers observed failing a task this
+        # query join ``excluded`` and are avoided for re-dispatch.
+        self.failure_detector = failure_detector
+        self.spool = spool
+        self.excluded: set = set()
+        self._excl_lock = threading.Lock()
+        self.task_retries = 0
+        self.speculative_launches = 0
+        self.speculative_wins = 0
 
     # -- fragmentation -------------------------------------------------
     def _remotable(self, node: PlanNode) -> bool:
@@ -292,6 +310,15 @@ class RemoteScheduler:
         return out
 
     def _run_fragments(self, frags: List[_Fragment]) -> Dict[int, Batch]:
+        """Attempt-aware dispatch: every (fragment, part) task runs a
+        retry loop (fte/retry.py budgets + backoff, replacement worker
+        per attempt), completed attempts commit their page frames to
+        the spool (first-commit-wins; fte/spool.py), and a speculation
+        monitor re-dispatches stragglers (fte/speculate.py). The old
+        single-shot path is the degenerate case: retry_policy=NONE, no
+        spool, zero extra attempts."""
+        import time as _time
+        from ..serde import deserialize_batch
         qid = uuid.uuid4().hex[:12]
         nparts = len(self.workers)
         session = self.session
@@ -300,79 +327,331 @@ class RemoteScheduler:
         hpc = int(session.get("hash_partition_count"))
         if hpc > 0:
             nparts = min(nparts, hpc)
-        results: Dict[int, List[Optional[Batch]]] = {
-            f.fid: [None] * nparts for f in frags}
+        policy = RetryPolicy.from_session(session)
+        speculation_on = bool(session.get("speculation_enabled")) \
+            and len(self.workers) > 1
+        # spooling engages only when a duplicate attempt is possible
+        # (retry or speculation): retry_policy=NONE stays the legacy
+        # in-memory path with zero disk traffic
+        use_spool = policy.enabled or speculation_on
+        if use_spool and self.spool is None:
+            from ..fte.spool import default_spool
+            self.spool = default_spool()
+        spool = self.spool if use_spool else None
+        if spool is not None:
+            try:        # ride-along TTL sweep (time-gated internally)
+                spool.maybe_cleanup()
+            except Exception:   # noqa: BLE001
+                pass
+        controller = RetryController(policy)
+        straggler = StragglerDetector(
+            multiplier=float(session.get("speculation_multiplier")),
+            min_runtime_s=int(
+                session.get("speculation_min_runtime_ms")) / 1000.0)
         worker_stats: Dict[int, List[List[NodeStats]]] = {
             f.fid: [] for f in frags}
         worker_resources: List[Tuple[int, int]] = []  # (peak, spill)
-        errors: List[str] = []
         trace = getattr(session, "trace", None)
         trace_parent = trace.current() if trace is not None else None
         events = getattr(session, "events", None)
 
         payloads = {f.fid: to_jsonable(f.plan) for f in frags}
+        tasks = [_TaskRun(f, part)
+                 for f in frags for part in range(nparts)]
 
-        def run_one(f: _Fragment, wi: int):
-            import time as _time
+        def alive(wi: int) -> bool:
+            det = self.failure_detector
+            return det is None or det.is_alive(self.workers[wi].base_uri)
+
+        def run_attempt(st: _TaskRun, attempt: int, wi: int,
+                        speculative: bool = False) -> Optional[str]:
+            """One attempt of task ``st`` on worker ``wi``; returns an
+            error string on failure, None on success OR benign loss to
+            a sibling attempt."""
+            f = st.fragment
+            tid = f"{qid}.{f.fid}.{st.part}.a{attempt}"
+            client = self.workers[wi]
             t0 = _time.perf_counter()
+            if not speculative:
+                with st.lock:
+                    st.running_since = t0
+                    st.running_worker = wi
             try:
-                client = self.workers[wi]
-                tid = f"{qid}.{f.fid}.{wi}"
                 client.submit_fragment(
                     tid, payloads[f.fid],
                     catalog=session.catalog, schema=session.schema,
-                    part=wi, nparts=nparts,
+                    part=st.part, nparts=nparts,
                     properties=dict(session.properties),
-                    collect_stats=self.collect_stats)
-                pages = client.pages(
-                    tid, cancel=getattr(session, "cancel", None))
-                results[f.fid][wi] = (device_concat(pages)
-                                      if len(pages) > 1 else
-                                      pages[0] if pages else None)
-                t1 = _time.perf_counter()
-                # telemetry is best-effort: the result pages are
-                # already in hand, so a failed stats fetch (transient
-                # status GET error, graft bug) must never fail the
-                # query that produced them
-                try:
-                    if self.collect_stats:
-                        status = client.status(tid)
-                        reported = [NodeStats.from_dict(d) for d in
-                                    status.get("nodeStats") or []]
-                        if reported:
-                            worker_stats[f.fid].append(reported)
-                        # list.append is atomic; sums happen after join
-                        worker_resources.append((
-                            int(status.get("peakMemoryBytes") or 0),
-                            int(status.get("spillBytes") or 0)))
-                        if trace is not None:
-                            sp = trace.record(
-                                f"fragment_{f.fid}_execute", t0, t1,
-                                parent=trace_parent, worker=wi,
-                                task=tid)
-                            trace.graft(sp, status.get("spans") or [])
-                    # a remote task IS this engine's split of work: its
-                    # completion is the SplitCompleted lifecycle event
-                    if events is not None:
-                        from ..server.events import SplitCompletedEvent
-                        events.split_completed(SplitCompletedEvent(
-                            getattr(session, "query_id", "") or qid,
-                            f"task:{tid}", t1 - t0))
-                except Exception:      # noqa: BLE001
-                    pass
+                    collect_stats=self.collect_stats,
+                    attempt=attempt, spool=spool is not None)
+                # the watch event aborts this attempt's page pull the
+                # moment a sibling attempt wins (or the user cancels)
+                watch = _MultiEvent(getattr(session, "cancel", None),
+                                    st.done)
+                frames = client.pages_raw(
+                    tid, cancel=watch,
+                    timeout_s=float(session.get("remote_task_timeout")))
             except Exception as e:     # noqa: BLE001
-                errors.append(f"task {f.fid}@worker{wi}: "
-                              f"{type(e).__name__}: {e}")
+                st.last_window = (t0, _time.perf_counter())
+                if not speculative:
+                    with st.lock:
+                        st.running_since = None  # not running anywhere:
+                        # the speculation monitor must not read a retry
+                        # backoff as a straggling attempt
+                if st.done.is_set():
+                    if not st.failed:
+                        return None     # a sibling attempt already won
+                    # the task already failed permanently elsewhere and
+                    # this pull was watch-aborted: not evidence against
+                    # THIS worker — no detector demerit, no exclusion
+                    return (f"fragment {f.fid} task {tid}: aborted "
+                            "(task already failed)")
+                cancel = getattr(session, "cancel", None)
+                if cancel is not None and cancel.is_set():
+                    # a user cancel is not the worker's failure: no
+                    # detector demerit, no exclusion
+                    return (f"fragment {f.fid} task {tid}: canceled")
+                if self.failure_detector is not None:
+                    self.failure_detector.record_task_failure(
+                        client.base_uri, f"{type(e).__name__}: {e}")
+                with self._excl_lock:
+                    self.excluded.add(wi)
+                return (f"fragment {f.fid} task {tid} on worker "
+                        f"{client.base_uri}: {type(e).__name__}: {e}")
+            t1 = _time.perf_counter()
+            st.last_window = (t0, t1)
+            if self.failure_detector is not None:
+                self.failure_detector.record_task_success(
+                    client.base_uri)
+            straggler.record(f.fid, t1 - t0)
+            batches = None
+            if spool is None:
+                # decode in the attempt thread so N pullers overlap
+                # deserialization (the pre-FTE path's concurrency); a
+                # bad frame is a retriable attempt failure
+                try:
+                    batches = [deserialize_batch(fr) for fr in frames]
+                except Exception as e:     # noqa: BLE001
+                    return (f"fragment {f.fid} task {tid}: "
+                            f"deserialize failed: "
+                            f"{type(e).__name__}: {e}")
+            # first-commit-wins: with a spool the COMMITTED marker is
+            # the arbiter (a late duplicate is discarded on disk);
+            # without one the in-memory winner slot is
+            winner_attempt = attempt
+            if spool is not None:
+                try:
+                    winner_attempt = spool.commit(qid, f.fid, st.part,
+                                                  attempt, frames)
+                except Exception as e:     # noqa: BLE001 — ENOSPC etc
+                    # an unwritable spool is a retriable attempt
+                    # failure, not a hung query
+                    return (f"fragment {f.fid} task {tid}: spool "
+                            f"commit failed: {type(e).__name__}: {e}")
+            won = False
+            with st.lock:
+                if st.winner is None and winner_attempt == attempt:
+                    st.winner = (attempt, wi, speculative)
+                    if spool is None:
+                        st.batches = batches
+                    won = True
+            if not won:
+                return None     # duplicate output discarded
+            # from here on the winner MUST set st.done (finally below):
+            # a crash between winner-set and done-set would strand the
+            # main thread's untimed wait
+            try:
+                if speculative:
+                    self.speculative_wins += 1
+                    SPECULATIVE_WINS.inc()
+                # telemetry is best-effort: the result pages are
+                # already committed, so a failed stats fetch (transient
+                # status GET error, graft bug) must never fail the
+                # query
+                if self.collect_stats:
+                    status = client.status(tid)
+                    reported = [NodeStats.from_dict(d) for d in
+                                status.get("nodeStats") or []]
+                    if reported:
+                        worker_stats[f.fid].append(reported)
+                    # list.append is atomic; sums happen after the wait
+                    worker_resources.append((
+                        int(status.get("peakMemoryBytes") or 0),
+                        int(status.get("spillBytes") or 0)))
+                    if trace is not None:
+                        sp = trace.record(
+                            f"fragment_{f.fid}_execute", t0, t1,
+                            parent=trace_parent, worker=wi,
+                            task=tid, attempt=attempt,
+                            speculative=speculative)
+                        trace.graft(sp, status.get("spans") or [])
+                # a remote task IS this engine's split of work: its
+                # completion is the SplitCompleted lifecycle event
+                if events is not None:
+                    from ..server.events import SplitCompletedEvent
+                    events.split_completed(SplitCompletedEvent(
+                        getattr(session, "query_id", "") or qid,
+                        f"task:{tid}", t1 - t0))
+            except Exception:      # noqa: BLE001
+                pass
+            finally:
+                st.done.set()
+            return None
 
-        threads = [threading.Thread(target=run_one, args=(f, wi))
-                   for f in frags for wi in range(nparts)]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        if errors:
-            raise QueryError("remote task failed: "
-                             + "; ".join(errors[:3]))
+        def run_task(st: _TaskRun):
+            """Primary attempt loop: dispatch, and on failure consult
+            the retry budgets, pick a replacement worker, back off,
+            go again."""
+            failures = 0
+            attempt = st.next_attempt()
+            while True:
+                with self._excl_lock:
+                    banned = frozenset(self.excluded)
+                wi = pick_worker(len(self.workers), st.part, attempt,
+                                 banned, alive)
+                try:
+                    err = run_attempt(st, attempt, wi)
+                except Exception as e:   # noqa: BLE001 — a bug in the
+                    # attempt path must surface as a task failure, not
+                    # kill this daemon thread with st.done forever
+                    # unset (the main wait has no timeout)
+                    err = (f"fragment {st.fragment.fid} attempt "
+                           f"{attempt}: internal: "
+                           f"{type(e).__name__}: {e}")
+                if err is None:
+                    return
+                failures += 1
+                st.errors.append(err)
+                cancel = getattr(session, "cancel", None)
+                canceled = cancel is not None and cancel.is_set()
+                if canceled or not controller.record_failure(
+                        (st.fragment.fid, st.part)):
+                    # out of attempts — but first-completion-wins cuts
+                    # both ways: a healthy speculative duplicate still
+                    # in flight decides the task's fate, not this
+                    # exhausted primary (setting done now would abort
+                    # its page pull via the _MultiEvent watch)
+                    with st.lock:
+                        spec_pending = (st.speculated
+                                        and st.winner is None)
+                    if spec_pending and not canceled:
+                        st.spec_done.wait()
+                    with st.lock:
+                        if st.winner is None:
+                            st.failed = True
+                    st.done.set()
+                    return
+                self.task_retries += 1
+                TASK_RETRIES.inc()
+                if trace is not None:
+                    t0, t1 = st.last_window
+                    trace.record(
+                        f"fragment_{st.fragment.fid}_retry", t0, t1,
+                        parent=trace_parent, part=st.part,
+                        worker=wi, attempt=attempt, error=err[-160:])
+                delay = backoff_delay(
+                    policy, failures,
+                    f"{qid}.{st.fragment.fid}.{st.part}")
+                if st.done.wait(delay):
+                    return   # a speculative sibling won during backoff
+                attempt = st.next_attempt()
+
+        def run_speculative(st: _TaskRun, attempt: int, wi: int):
+            try:
+                err = run_attempt(st, attempt, wi, speculative=True)
+                if err is not None:
+                    st.errors.append("[speculative] " + err)
+            except Exception as e:       # noqa: BLE001
+                st.errors.append("[speculative] internal: "
+                                 f"{type(e).__name__}: {e}")
+            finally:
+                # the retry loop may be blocked on this duplicate's
+                # outcome before declaring the task failed
+                st.spec_done.set()
+
+        def monitor(stop_ev: threading.Event):
+            """Straggler watch: poll running tasks' elapsed time
+            against the fragment's completed-runtime median; launch at
+            most one speculative duplicate per task on a different
+            worker."""
+            while not stop_ev.wait(0.05):
+                pending = [st for st in tasks if not st.done.is_set()]
+                if not pending:
+                    return
+                for st in pending:
+                    if st.speculated:
+                        continue
+                    with st.lock:
+                        t0 = st.running_since
+                        wi_cur = st.running_worker
+                        settled = st.winner is not None
+                    # winner set but done not yet (the winner thread is
+                    # in its best-effort telemetry block): the task is
+                    # finished — duplicating it would only burn query
+                    # retry budget
+                    if settled or t0 is None:
+                        continue
+                    elapsed = _time.perf_counter() - t0
+                    if not straggler.is_straggler(st.fragment.fid,
+                                                  elapsed):
+                        continue
+                    if not controller.grant_speculation(
+                            (st.fragment.fid, st.part)):
+                        continue
+                    st.speculated = True
+                    attempt = st.next_attempt()
+                    with self._excl_lock:
+                        banned = frozenset(
+                            self.excluded
+                            | ({wi_cur} if wi_cur is not None
+                               else set()))
+                    wi = pick_worker(len(self.workers), st.part,
+                                     attempt, banned, alive)
+                    if wi == wi_cur:
+                        # every other worker is banned or dead: a
+                        # duplicate on the straggler itself cannot
+                        # help — skip the launch (the consumed budget
+                        # slot is the degenerate fleet's toll). The
+                        # no-op duplicate is resolved immediately so
+                        # the retry loop never waits on it
+                        st.spec_done.set()
+                        continue
+                    self.speculative_launches += 1
+                    SPECULATIVE_TASKS.inc()
+                    if trace is not None:
+                        trace.record(
+                            f"fragment_{st.fragment.fid}_speculate",
+                            t0, _time.perf_counter(),
+                            parent=trace_parent, part=st.part,
+                            attempt=attempt, worker=wi,
+                            straggler_worker=wi_cur)
+                    threading.Thread(target=run_speculative,
+                                     args=(st, attempt, wi),
+                                     daemon=True).start()
+
+        # daemon threads + event-based completion: first-completion-
+        # wins must not block on joining a loser thread stuck in a
+        # page pull on a wedged worker (its watch event unblocks it at
+        # the next poll; a fully hung socket times out on its own)
+        for st in tasks:
+            threading.Thread(target=run_task, args=(st,),
+                             daemon=True).start()
+        stop_ev = threading.Event()
+        if speculation_on:
+            threading.Thread(target=monitor, args=(stop_ev,),
+                             daemon=True).start()
+        try:
+            for st in tasks:
+                st.done.wait()
+        finally:
+            stop_ev.set()
+        failed = [st for st in tasks if st.failed]
+        if failed:
+            if spool is not None:
+                spool.release(qid)
+            raise QueryError(
+                "remote task failed: " + "; ".join(
+                    "; ".join(st.errors[-2:]) for st in failed[:3]))
         if self.collect_stats:
             self.fragment_expected = nparts
             for f in frags:
@@ -383,14 +662,91 @@ class RemoteScheduler:
                 self.peak_memory_bytes = max(self.peak_memory_bytes,
                                              peak)
                 self.spill_bytes += spill
+        # gather: the combine input comes OFF THE SPOOL (when one is
+        # configured) — completed fragment output survives outside the
+        # dispatch threads' memory, which is what makes a late retry
+        # of the combine (or a restarted coordinator reading a shared
+        # spool dir) possible at all
         out: Dict[int, Batch] = {}
-        for f in frags:
-            parts = [b for b in results[f.fid] if b is not None]
-            if not parts:
-                raise QueryError(f"fragment {f.fid} returned no pages")
-            out[f.fid] = (device_concat(parts) if len(parts) > 1
-                          else parts[0])
+        try:
+            for f in frags:
+                batches: List[Batch] = []
+                for st in tasks:
+                    if st.fragment is not f:
+                        continue
+                    if spool is None:
+                        part_batches = st.batches
+                    else:
+                        frames = spool.read(qid, f.fid, st.part)
+                        part_batches = (None if frames is None else
+                                        [deserialize_batch(fr)
+                                         for fr in frames])
+                    if part_batches is None:
+                        # the task WON, so its output must be readable
+                        # — silently skipping a part would return an
+                        # answer missing a whole shard's rows
+                        raise QueryError(
+                            f"fragment {f.fid} part {st.part}: "
+                            "committed output missing from spool")
+                    batches.extend(part_batches)
+                if not batches:
+                    raise QueryError(
+                        f"fragment {f.fid} returned no pages")
+                out[f.fid] = (device_concat(batches)
+                              if len(batches) > 1 else batches[0])
+        finally:
+            if spool is not None:
+                spool.release(qid)
         return out
+
+
+class _TaskRun:
+    """One (fragment, part) task's dispatch state across attempts
+    (the reference's per-task attempt bookkeeping in
+    EventDrivenFaultTolerantQueryScheduler, collapsed)."""
+
+    __slots__ = ("fragment", "part", "done", "spec_done", "lock",
+                 "failed", "errors", "batches", "winner", "_attempts",
+                 "running_since", "running_worker", "speculated",
+                 "last_window")
+
+    def __init__(self, fragment: _Fragment, part: int):
+        self.fragment = fragment
+        self.part = part
+        self.done = threading.Event()
+        # resolved outcome of the (at most one) speculative duplicate
+        self.spec_done = threading.Event()
+        self.lock = threading.Lock()
+        self.failed = False
+        self.errors: List[str] = []
+        self.batches: Optional[List[Batch]] = None  # no-spool result
+        self.winner: Optional[Tuple[int, int, bool]] = None
+        self._attempts = 0
+        self.running_since: Optional[float] = None
+        self.running_worker: Optional[int] = None
+        self.speculated = False
+        self.last_window: Tuple[float, float] = (0.0, 0.0)
+
+    def next_attempt(self) -> int:
+        """Allocate a unique attempt id (shared by the retry loop and
+        the speculation monitor — task ids must never collide)."""
+        with self.lock:
+            attempt = self._attempts
+            self._attempts += 1
+            return attempt
+
+
+class _MultiEvent:
+    """``is_set()`` ORs several events — the page pull's cancel hook
+    combines user cancellation with sibling-attempt-won abort."""
+
+    __slots__ = ("_events",)
+
+    def __init__(self, *events):
+        self._events = [e for e in events if e is not None]
+
+    def is_set(self) -> bool:
+        return any(e.is_set() for e in self._events)
 
 
 class _Placeholder(PlanNode):
@@ -432,7 +788,8 @@ class DistributedHostQueryRunner:
 
     def __init__(self, worker_uris: List[str],
                  session: Optional[Session] = None, catalogs=None,
-                 collect_node_stats: bool = False):
+                 collect_node_stats: bool = False,
+                 failure_detector=None, spool=None):
         from ..runner import LocalQueryRunner
         self._local = LocalQueryRunner(session=session,
                                        catalogs=catalogs)
@@ -440,10 +797,16 @@ class DistributedHostQueryRunner:
         self.catalogs = self._local.catalogs
         self.worker_uris = list(worker_uris)
         self.collect_node_stats = collect_node_stats
+        # fault-tolerant execution plumbing (trino_tpu/fte/): both are
+        # optional — the scheduler creates a default LocalDirSpool when
+        # the session asks for retry_policy=TASK and none was given
+        self.failure_detector = failure_detector
+        self.spool = spool
 
     def execute(self, sql: str):
         import time as _time
-        from ..obs.metrics import QUERY_WALL_SECONDS
+        from ..obs.metrics import (QUERY_PEAK_MEMORY_BYTES,
+                                   QUERY_WALL_SECONDS)
         from ..obs.trace import QueryTrace, null_span
         from ..planner.logical import LogicalPlanner
         from ..planner.optimizer import optimize
@@ -480,7 +843,9 @@ class DistributedHostQueryRunner:
                 plan = optimize(plan, self.catalogs, self.session)
             sched = RemoteScheduler(
                 self.worker_uris, self.catalogs, self.session,
-                collect_stats=collect)
+                collect_stats=collect,
+                failure_detector=self.failure_detector,
+                spool=self.spool)
             with sp("execute"):
                 batch = sched.execute_plan(plan)
         finally:
@@ -489,6 +854,11 @@ class DistributedHostQueryRunner:
             # finally for the same reason: failed/timed-out queries
             # must not vanish from the SLO dashboards
             QUERY_WALL_SECONDS.observe(_time.perf_counter() - t0)
+        if collect:
+            # sched.peak_memory_bytes is only populated when worker
+            # stats were fetched; a non-stats query must not clobber
+            # the gauge's last real sample with 0
+            QUERY_PEAK_MEMORY_BYTES.set(sched.peak_memory_bytes)
         if analyze:
             from .executor import render_analyze_lines
             lines = render_analyze_lines(plan_tree_lines(plan),

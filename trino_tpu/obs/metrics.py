@@ -78,7 +78,13 @@ class _Metric:
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
-        for key, v in self.samples():
+        samples = self.samples()
+        if not samples and not self.labelnames:
+            # Prometheus convention: an unlabeled family is initialized
+            # to 0 at registration — scrapers can alert on rate() the
+            # moment the process boots, not after the first event
+            samples = [((), 0.0)]
+        for key, v in samples:
             lines.append(
                 f"{self.name}{self._render_labels(key)} {_fmt(v)}")
         return lines
@@ -247,6 +253,14 @@ METRICS = MetricsRegistry()
 QUERY_WALL_SECONDS = METRICS.histogram(
     "trino_tpu_query_wall_seconds",
     "End-to-end query wall time through the runner")
+
+# scrape-friendly spot value (ROADMAP follow-on): the most recently
+# completed query's peak reserved memory. A scraper sampling between
+# queries sees the live high-water mark; QueryCompletedEvent carries
+# the authoritative per-query figure for audit sinks.
+QUERY_PEAK_MEMORY_BYTES = METRICS.gauge(
+    "trino_tpu_query_peak_memory_bytes",
+    "Peak reserved memory (bytes) of the most recently completed query")
 
 
 def write_exposition(handler) -> None:

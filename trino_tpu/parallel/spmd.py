@@ -23,7 +23,18 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+try:
+    from jax import shard_map
+except ImportError:
+    # pre-0.6 jax ships shard_map under experimental with the old
+    # check_rep knob (check_vma is its rename); adapt so the call
+    # sites below stay on the modern spelling
+    from jax.experimental.shard_map import shard_map as _shard_map_exp
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _shard_map_exp(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
 
 from ..columnar import Batch, Column
 from ..ops.groupby import AggInput, group_aggregate

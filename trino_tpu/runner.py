@@ -102,7 +102,8 @@ class LocalQueryRunner:
 
     # ------------------------------------------------------------------
     def execute(self, sql: str) -> QueryResult:
-        from .obs.metrics import QUERY_WALL_SECONDS
+        from .obs.metrics import (QUERY_PEAK_MEMORY_BYTES,
+                                  QUERY_WALL_SECONDS)
         from .obs.trace import QueryTrace
         t0 = time.perf_counter()
         # tracing rides with stats collection: it is cheap but not
@@ -142,6 +143,7 @@ class LocalQueryRunner:
         result.query_id = qid
         result.wall_s = time.perf_counter() - t0
         result.trace = trace
+        QUERY_PEAK_MEMORY_BYTES.set(result.peak_memory_bytes)
         return result
 
     # ------------------------------------------------------------------

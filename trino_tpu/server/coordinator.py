@@ -263,7 +263,7 @@ class Coordinator:
     def __init__(self, port: int = 0, distributed: bool = False,
                  catalogs=None, resource_groups=None,
                  event_listeners=None, authenticator=None,
-                 worker_uris=None):
+                 worker_uris=None, failure_detector=None):
         from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
@@ -274,6 +274,22 @@ class Coordinator:
         # processes (exec/remote.py; reference: DiscoveryNodeManager's
         # active worker set feeding SqlQueryScheduler)
         self.workers = list(worker_uris or [])
+        # fault-tolerant execution (trino_tpu/fte/): one failure
+        # detector and one spool shared by every query. The default
+        # detector is feedback-driven (schedulers report observed task
+        # failures); call failure_detector.start() to add the active
+        # heartbeat loop (server/main.py does for configured fleets).
+        self.failure_detector = failure_detector
+        if self.failure_detector is None and self.workers:
+            from .failure import HeartbeatFailureDetector
+            self.failure_detector = HeartbeatFailureDetector()
+        if self.failure_detector is not None:
+            for w in self.workers:
+                self.failure_detector.add_service(w)
+        self.spool = None
+        if self.workers:
+            from ..fte.spool import LocalDirSpool
+            self.spool = LocalDirSpool()
 
         # one shared CatalogManager (memory-connector state spans
         # queries) and one shared mesh
@@ -292,7 +308,8 @@ class Coordinator:
                 from ..exec.remote import DistributedHostQueryRunner
                 return DistributedHostQueryRunner(
                     live, session=session, catalogs=self._catalogs,
-                    collect_node_stats=True)
+                    collect_node_stats=True,
+                    failure_detector=detector, spool=self.spool)
             # per-node wall/row stats feed the web UI's query detail
             # (OperatorStats is always-on in the reference coordinator)
             return LocalQueryRunner(session=session,
@@ -360,6 +377,8 @@ class Coordinator:
 
     def stop(self):
         METRICS.unregister_collector(self._metric_collector)
+        if self.failure_detector is not None:
+            self.failure_detector.stop()
         self._httpd.shutdown()
 
     # ---- resource payloads -------------------------------------------

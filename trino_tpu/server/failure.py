@@ -105,6 +105,22 @@ class HeartbeatFailureDetector:
                 if st is not None:
                     st.record(ok, err)
 
+    # --- scheduler feedback ----------------------------------------------
+    def record_task_failure(self, uri: str,
+                            error: Optional[str] = None) -> None:
+        """An observed task failure on a node is a failed probe: the
+        scheduler (exec/remote.py) reports dispatch/exchange errors here
+        so the decayed ratio reflects real work, not just pings — the
+        reference's RemoteTask failure feedback into the failure
+        detector. Auto-registers unknown services (a worker can fail a
+        task before its first heartbeat)."""
+        with self._lock:
+            self._stats.setdefault(uri, _Stats()).record(False, error)
+
+    def record_task_success(self, uri: str) -> None:
+        with self._lock:
+            self._stats.setdefault(uri, _Stats()).record(True)
+
     def start(self) -> "HeartbeatFailureDetector":
         def loop():
             while not self._stop.wait(self.interval_s):
@@ -122,6 +138,16 @@ class HeartbeatFailureDetector:
             st = self._stats.get(uri)
             if st is None or st.weight < self.warmup:
                 return True       # unknown/warming-up nodes pass
+            # stale evidence ages out: ``_decay`` only runs inside
+            # record(), so a node that stops receiving probes
+            # (feedback-only detectors have no probe loop) would keep
+            # its last ratio forever — a couple of transient task
+            # failures would exclude it permanently and, excluded, it
+            # never gets the task that could redeem it. After four
+            # quiet decay windows the verdict expires and the node
+            # earns a fresh chance.
+            if time.time() - st.last_update > 4 * st.decay_seconds:
+                return True
             return st.failure_ratio <= self.threshold
 
     def failed(self) -> List[str]:

@@ -70,6 +70,10 @@ def main(argv=None) -> int:
     ap.add_argument("--port", type=int, default=None)
     ap.add_argument("--distributed", action="store_true",
                     help="execute over the device mesh")
+    ap.add_argument("--workers", default=None,
+                    help="comma-separated worker base URIs to dispatch "
+                         "leaf fragments to (exec/remote.py); also "
+                         "settable as worker.uris in config.properties")
     args = ap.parse_args(argv)
 
     props: Dict[str, str] = {}
@@ -99,11 +103,20 @@ def main(argv=None) -> int:
         with open(pw_path) as f:
             authenticator = load_password_file(f.read())
 
+    workers = [w.strip() for w in
+               (args.workers or props.get("worker.uris", "")).split(",")
+               if w.strip()]
+
     co = Coordinator(port=port,
                      distributed=args.distributed,
                      catalogs=build_catalogs(args.etc_dir, plugins),
                      resource_groups=resource_groups,
-                     authenticator=authenticator).start()
+                     authenticator=authenticator,
+                     worker_uris=workers).start()
+    if workers and co.failure_detector is not None:
+        # a configured fleet gets the active heartbeat loop on top of
+        # the scheduler's task-failure feedback
+        co.failure_detector.start()
     print(f"trino-tpu coordinator listening on {co.base_uri}"
           f" (web UI: {co.base_uri}/ui)")
 

@@ -84,8 +84,14 @@ class _Task:
     """One task's lifecycle + output buffer (execution/SqlTask.java +
     the ClientBuffer token protocol)."""
 
-    def __init__(self, task_id: str):
+    def __init__(self, task_id: str, attempt: int = 0, spool=None):
         self.task_id = task_id
+        # fault-tolerant execution: which attempt of its (fragment,
+        # part) this task is (exec/remote.py re-dispatches failed
+        # tasks with fresh attempt ids), and the spool its completed
+        # output is committed to so it survives task eviction
+        self.attempt = attempt
+        self.spool = spool
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.pages: List[bytes] = []
@@ -140,6 +146,16 @@ class _Task:
                 from ..serde import CODEC_STORE
                 codec = CODEC_STORE
             self.pages = paginate(res, codec=codec)
+            if self.spool is not None:
+                # durable output: completed pages outlive the in-memory
+                # task entry, so an aborted/evicted task's consumer can
+                # still re-read them through /v1/spool (the
+                # exchange-spooling half of fault-tolerant execution)
+                try:
+                    self.spool.commit(self.task_id, 0, 0,
+                                      self.attempt, self.pages)
+                except Exception:    # noqa: BLE001 — spool best-effort
+                    pass
             self.state = "FINISHED"
         except Exception as e:   # noqa: BLE001
             self.state = "FAILED"
@@ -153,9 +169,19 @@ class TaskWorkerServer:
     """A worker node: accepts tasks, executes them, serves result pages.
     One process per worker (the reference's worker JVM)."""
 
-    def __init__(self, port: int = 0):
+    def __init__(self, port: int = 0, spool_dir: Optional[str] = None):
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
+        # worker-side spool (fte/spool.py): tasks submitted with
+        # "spool": true commit their output pages here, keyed by task
+        # id, and /v1/spool serves them even after the task is evicted.
+        # The base is kept SEPARATE from the coordinator's (task-id
+        # keys vs query-id keys) so neither side's TTL sweep can reap
+        # the other's live entries
+        from ..config import CONFIG
+        from ..fte.spool import LocalDirSpool
+        self.spool = LocalDirSpool(
+            spool_dir or CONFIG.spool_dir + "-worker")
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -221,6 +247,35 @@ class TaskWorkerServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # /v1/spool/{task_id}/{token}: committed output pages
+                # of a (possibly evicted) task, straight off the spool
+                # — same complete/next-token protocol as /results
+                if len(parts) == 4 and parts[:2] == ["v1", "spool"]:
+                    tid, token = parts[2], int(parts[3])
+                    # frame-at-a-time off the spool: reading the whole
+                    # committed set per token request would make an
+                    # N-page pull O(N^2) disk I/O and overcount the
+                    # spool-read byte metric by ~N x
+                    nframes = worker.spool.frame_count(tid, 0, 0)
+                    if nframes is None:
+                        self.send_error(404)
+                        return
+                    complete = token >= nframes
+                    body = (b"" if complete else
+                            worker.spool.read_frame(tid, 0, 0, token))
+                    if body is None:     # reaped between count & read
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("X-TT-Complete",
+                                     "true" if complete else "false")
+                    self.send_header("X-TT-Next-Token", str(token + 1))
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 # /v1/task/{id} -> status (incl. the worker-side
                 # operator stats + span tree for the stage rollup)
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
@@ -231,6 +286,7 @@ class TaskWorkerServer:
                     body = json.dumps(
                         {"taskId": t.task_id,
                          "state": t.state,
+                         "attempt": t.attempt,
                          "error": t.error,
                          "nodeStats": t.node_stats,
                          "spans": t.spans,
@@ -265,11 +321,17 @@ class TaskWorkerServer:
 
     # -- task manager (SqlTaskManager) --------------------------------
     def create_task(self, tid: str, payload: dict) -> _Task:
+        try:      # reap expired spooled output (time-gated internally)
+            self.spool.maybe_cleanup()
+        except Exception:        # noqa: BLE001
+            pass
         with self._lock:
             t = self._tasks.get(tid)
             if t is not None:
                 return t          # idempotent update (TaskResource)
-            t = _Task(tid)
+            t = _Task(tid, attempt=int(payload.get("attempt") or 0),
+                      spool=(self.spool if payload.get("spool")
+                             else None))
             self._tasks[tid] = t
         threading.Thread(target=t.run, args=(payload,),
                          daemon=True).start()
@@ -363,13 +425,17 @@ class RemoteTaskClient:
                         catalog: str, schema: str, part: int,
                         nparts: int,
                         properties: Optional[dict] = None,
-                        collect_stats: bool = False):
+                        collect_stats: bool = False,
+                        attempt: int = 0, spool: bool = False):
         """POST a serialized plan fragment + split share (the
-        HttpRemoteTask TaskUpdateRequest analog)."""
+        HttpRemoteTask TaskUpdateRequest analog). ``attempt`` tags the
+        task's retry/speculation generation; ``spool`` asks the worker
+        to commit completed output pages to its spool."""
         return self._post(task_id, {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
             "collect_stats": collect_stats,
+            "attempt": attempt, "spool": spool,
             "properties": properties or {}})
 
     def status(self, task_id: str) -> dict:
@@ -387,17 +453,22 @@ class RemoteTaskClient:
         with urllib.request.urlopen(req, timeout=30) as r:
             return json.loads(r.read())
 
-    def pages(self, task_id: str, cancel=None,
-              timeout_s: float = 600.0) -> List[Batch]:
-        """Pull every result page (token-acknowledged bounded poll).
-        ``cancel`` (a threading.Event) aborts the remote task and
-        raises between polls — the ExchangeClient cancel path;
-        ``timeout_s`` bounds the total wait on a wedged task (the old
-        long-poll's 300s server bound, now client-side)."""
+    def pages_raw(self, task_id: str, cancel=None,
+                  timeout_s: float = 600.0) -> List[bytes]:
+        """Pull every result page FRAME (token-acknowledged bounded
+        poll) — raw serialized bytes, so callers can spool them without
+        a decode/re-encode round trip. ``cancel`` (anything with
+        ``is_set()``) aborts the remote task and raises between polls —
+        the ExchangeClient cancel path; ``timeout_s`` bounds the total
+        wait on a wedged task. A 404 mid-pull (task evicted after
+        abort, worker restart) falls back to the worker's /v1/spool
+        endpoint once: committed output survives the task entry."""
+        import urllib.error
         import time as _time
         deadline = _time.monotonic() + timeout_s
-        out: List[Batch] = []
+        out: List[bytes] = []
         token = 0
+        from_spool = False
         while True:
             if _time.monotonic() > deadline:
                 try:
@@ -412,20 +483,44 @@ class RemoteTaskClient:
                 except Exception:       # noqa: BLE001
                     pass
                 raise RuntimeError(f"task {task_id} canceled")
-            with urllib.request.urlopen(
-                    f"{self.base_uri}/v1/task/{task_id}/results/{token}",
-                    timeout=600) as r:
-                if r.status == 202:     # still running: poll again
+            path = (f"/v1/spool/{task_id}/{token}" if from_spool
+                    else f"/v1/task/{task_id}/results/{token}")
+            try:
+                # per-request timeout bounded by the remaining attempt
+                # deadline: a half-open socket on a dead worker must
+                # not pin this pull past its budget
+                per_req = max(1.0, min(600.0,
+                                       deadline - _time.monotonic()))
+                with urllib.request.urlopen(
+                        f"{self.base_uri}{path}", timeout=per_req) as r:
+                    if r.status == 202:     # still running: poll again
+                        continue
+                    complete = r.headers.get("X-TT-Complete") == "true"
+                    body = r.read()
+            except urllib.error.HTTPError as e:
+                if e.code == 404 and not from_spool:
+                    from_spool = True   # restart the pull off the spool
+                    out, token = [], 0
                     continue
-                complete = r.headers.get("X-TT-Complete") == "true"
-                body = r.read()
+                raise
             if complete:
                 break
-            _M_PAGES.inc(direction="received")
-            _M_PAGE_BYTES.inc(len(body), direction="received")
-            out.append(deserialize_batch(body))
+            out.append(body)
             token += 1
+        # counted once at the end: a spool-fallback restart re-pulls
+        # from token 0 and must not double-count the first pass
+        if out:
+            _M_PAGES.inc(len(out), direction="received")
+            _M_PAGE_BYTES.inc(sum(len(b) for b in out),
+                              direction="received")
         return out
+
+    def pages(self, task_id: str, cancel=None,
+              timeout_s: float = 600.0) -> List[Batch]:
+        """`pages_raw` decoded into Batches."""
+        return [deserialize_batch(b) for b in
+                self.pages_raw(task_id, cancel=cancel,
+                               timeout_s=timeout_s)]
 
     def abort(self, task_id: str):
         req = urllib.request.Request(
