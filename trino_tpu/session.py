@@ -50,6 +50,30 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # cost-based join reorder/side decisions from connector statistics
     # (optimizer.use-table-statistics; planner/optimizer.py)
     "use_table_statistics": (bool, True),
+    # ---- fault-tolerant execution (trino_tpu/fte/) -------------------
+    # NONE fails the query on the first task failure; TASK re-dispatches
+    # failed leaf-fragment tasks (reference: RetryPolicy.java +
+    # SystemSessionProperties RETRY_POLICY)
+    "retry_policy": (str, "NONE"),
+    # TOTAL attempts per task incl. the first
+    # (task-retry-attempts-per-task)
+    "task_retry_attempts": (int, 4),
+    # extra attempts (retries + speculative duplicates) across the
+    # whole query (query-retry-attempts)
+    "query_retry_attempts": (int, 16),
+    # exponential backoff window between attempts
+    # (retry-initial-delay / retry-max-delay)
+    "retry_initial_delay_ms": (int, 50),
+    "retry_max_delay_ms": (int, 2000),
+    # client-side bound on one task attempt producing pages; a wedged
+    # worker turns into a retriable failure instead of a hung query
+    "remote_task_timeout": (int, 600),
+    # straggler speculation (fte/speculate.py): re-dispatch a running
+    # task once it exceeds multiplier x the fragment's median completed
+    # runtime (with an absolute floor), first-completion-wins
+    "speculation_enabled": (bool, False),
+    "speculation_multiplier": (float, 2.0),
+    "speculation_min_runtime_ms": (int, 200),
 }
 
 
