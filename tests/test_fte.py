@@ -410,6 +410,162 @@ def test_worker_spool_endpoint_survives_task_eviction():
         srv.stop()
 
 
+# --------------------------------------------------------------------------
+# pluggable spool backends: one idempotency/TTL contract, two backends
+# --------------------------------------------------------------------------
+
+@pytest.fixture(params=["local", "memory"])
+def any_spool(request, tmp_path):
+    """Each backend under the IDENTICAL suite: the local directory tree
+    and the object-store code path must be interchangeable behind the
+    SpoolManager contract. ``.age(query_id, seconds)`` backdates a
+    query's spooled state for the TTL tests."""
+    if request.param == "local":
+        import os
+        spool = LocalDirSpool(str(tmp_path), ttl_s=3600)
+
+        def age(query_id, seconds):
+            stale = time.time() - seconds
+            os.utime(tmp_path / query_id, (stale, stale))
+    else:
+        from trino_tpu.fte.objectstore import (InMemoryObjectStore,
+                                               ObjectStoreSpool)
+        store = InMemoryObjectStore()
+        spool = ObjectStoreSpool(store, ttl_s=3600, max_attempts=3,
+                                 backoff_initial_s=0.001)
+
+        def age(query_id, seconds):
+            with store._lock:
+                for k, (data, mt) in list(store._objects.items()):
+                    if k.startswith(f"{query_id}/"):
+                        store._objects[k] = (data, mt - seconds)
+    spool.age = age
+    return spool
+
+
+def test_spool_backend_commit_read_release(any_spool):
+    frames = [b"page-zero", b"page-one"]
+    assert any_spool.commit("q1", 0, 0, 0, frames) == 0
+    assert any_spool.committed_attempt("q1", 0, 0) == 0
+    assert any_spool.read("q1", 0, 0) == frames
+    assert any_spool.read("q1", 0, 1) is None    # nothing committed
+    any_spool.release("q1")
+    assert any_spool.read("q1", 0, 0) is None
+
+
+def test_spool_backend_first_commit_wins(any_spool):
+    before = _counter("trino_tpu_spool_duplicate_attempts_total")
+    assert any_spool.commit("q1", 2, 1, 0, [b"winner"]) == 0
+    assert any_spool.commit("q1", 2, 1, 1, [b"loser"]) == 0
+    assert any_spool.read("q1", 2, 1) == [b"winner"]
+    assert _counter(
+        "trino_tpu_spool_duplicate_attempts_total") == before + 1
+
+
+def test_spool_backend_release_tombstone(any_spool):
+    """A late loser completing after the query released its spool must
+    not resurrect the query's state (leak until TTL)."""
+    any_spool.commit("q", 0, 0, 0, [b"x"])
+    any_spool.release("q")
+    any_spool.commit("q", 0, 0, 1, [b"y"])
+    assert any_spool.read("q", 0, 0) is None
+
+
+def test_spool_backend_ttl(any_spool):
+    any_spool.commit("old_query", 0, 0, 0, [b"x"])
+    any_spool.commit("new_query", 0, 0, 0, [b"y"])
+    any_spool.age("old_query", 7200)
+    assert any_spool.cleanup() == 1
+    assert any_spool.read("old_query", 0, 0) is None
+    assert any_spool.read("new_query", 0, 0) == [b"y"]
+
+
+def test_spool_backend_frame_at_a_time(any_spool):
+    """The /v1/spool serving surface: per-frame reads agree with the
+    whole-attempt read, and uncommitted parts answer None."""
+    frames = [b"f0", b"f1", b"f2"]
+    any_spool.commit("q", 1, 0, 0, frames)
+    assert any_spool.frame_count("q", 1, 0) == 3
+    assert [any_spool.read_frame("q", 1, 0, i)
+            for i in range(3)] == frames
+    assert any_spool.frame_count("q", 9, 0) is None
+
+
+def test_make_spool_backend_selection():
+    from trino_tpu.fte.objectstore import ObjectStoreSpool
+    from trino_tpu.fte.spool import default_spool, make_spool
+    assert isinstance(make_spool("local"), LocalDirSpool)
+    assert isinstance(make_spool("memory"), ObjectStoreSpool)
+    with pytest.raises(ValueError, match="unknown spool backend"):
+        make_spool("s3://not-wired")
+    # the process-wide default is a singleton PER backend name
+    assert default_spool("memory") is default_spool("memory")
+    assert default_spool("local") is not default_spool("memory")
+
+
+# --------------------------------------------------------------------------
+# object-store backend: injected transient faults vs the retry budget
+# --------------------------------------------------------------------------
+
+def _mem_spool(max_attempts=4):
+    from trino_tpu.fte.objectstore import (InMemoryObjectStore,
+                                           ObjectStoreSpool)
+    store = InMemoryObjectStore()
+    return store, ObjectStoreSpool(store, max_attempts=max_attempts,
+                                   backoff_initial_s=0.001)
+
+
+def test_objectstore_survives_transient_put_get_failures():
+    """The acceptance fault: 503-SlowDown-shaped failures on put and
+    get resolve inside the bounded retry budget — the commit lands,
+    the read returns the committed frames, and the retry counter
+    records the recoveries."""
+    store, spool = _mem_spool(max_attempts=4)
+    retried = _counter("trino_tpu_objectstore_retries_total")
+    store.inject_failures(3, ops=["put"])
+    assert spool.commit("q", 0, 0, 0, [b"a", b"b"]) == 0
+    store.inject_failures(2, ops=["get"])
+    assert spool.read("q", 0, 0) == [b"a", b"b"]
+    assert _counter("trino_tpu_objectstore_retries_total") >= retried + 5
+
+
+def test_objectstore_retry_budget_exhausted_raises():
+    """A dead bucket fails the attempt (for the task-retry engine to
+    handle) instead of hanging the query in an infinite retry loop."""
+    from trino_tpu.fte.objectstore import TransientObjectStoreError
+    store, spool = _mem_spool(max_attempts=2)
+    store.inject_failures(50)
+    with pytest.raises(TransientObjectStoreError):
+        spool.commit("q", 0, 0, 0, [b"x"])
+    # the store heals -> the next attempt goes through untouched
+    store.inject_failures(0)
+    assert spool.commit("q", 0, 0, 1, [b"x"]) == 1
+
+
+def test_worker_killed_with_objectstore_spool_backend(workers,
+                                                     expected):
+    """The PR 5 acceptance kill, re-run with the object-store-shaped
+    spool active: retries spool their output through the bucket
+    emulation (request counter moves) and the query still completes."""
+    def ops_total():
+        return sum(v for _, v in METRICS.counter(
+            "trino_tpu_objectstore_requests_total").samples())
+
+    store, spool = _mem_spool()
+    killed = _FaultyWorker("kill")
+    ops_before = ops_total()
+    try:
+        runner = DistributedHostQueryRunner(
+            [killed.base_uri] + workers,
+            session=_task_session(), spool=spool)
+        res = runner.execute(SQL)
+    finally:
+        killed.stop()
+    assert res.rows == expected.rows
+    assert ops_total() > ops_before
+    assert store.op_counts.get("put", 0) > 0
+
+
 def test_fte_metrics_exposed(workers, expected):
     """The new families render in the Prometheus exposition with the
     names the ISSUE commits to."""

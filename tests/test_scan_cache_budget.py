@@ -95,3 +95,19 @@ def test_scan_reserves_against_memory_guard(monkeypatch):
         # ORDER BY defeats the streaming-aggregation path: the scan
         # itself must materialize
         r.execute("SELECT * FROM orders ORDER BY o_orderkey LIMIT 5")
+
+
+def test_split_share_scan_reserves_against_memory_guard():
+    """The WORKER split-share scan path (scan_partition set, as the
+    remote task runner does) hits the same reserve-before-allocate
+    discipline: an oversized fragment fails with the actionable memory
+    error instead of a raw HBM OOM mid-concat."""
+    from trino_tpu.exec import QueryError
+    from trino_tpu.exec.executor import Executor
+    r = LocalQueryRunner(session=Session(catalog="tpch", schema="tiny"))
+    r.session.properties["query_max_memory_per_node"] = 1 << 10
+    plan = r.plan_sql("SELECT * FROM orders ORDER BY o_orderkey")
+    worker_ex = Executor(r.catalogs, r.session)
+    worker_ex.scan_partition = (0, 2)
+    with pytest.raises(QueryError, match="memory limit"):
+        worker_ex.execute(plan)

@@ -242,3 +242,24 @@ def test_jwt_bearer_authentication():
             assert e.code == 403
     finally:
         coord.stop()
+
+
+def test_jwt_missing_exp_rejected_by_default():
+    """A token with no exp claim can never age out, so the default is
+    to reject it; require_exp=False opts back into the legacy
+    accept-forever behavior (for internal mint-on-boot tokens)."""
+    import time as _time
+    from trino_tpu.security import JwtAuthenticator
+
+    strict = JwtAuthenticator(b"secret-key")
+    eternal = strict.sign({"sub": "alice"})
+    assert strict.authenticate_token(eternal) is None
+    # a bounded token still authenticates under the strict default
+    bounded = strict.sign({"sub": "alice", "exp": _time.time() + 60})
+    assert strict.authenticate_token(bounded) == "alice"
+
+    lax = JwtAuthenticator(b"secret-key", require_exp=False)
+    assert lax.authenticate_token(eternal) == "alice"
+    # opting out of require_exp must not weaken expiry enforcement
+    expired = lax.sign({"sub": "alice", "exp": _time.time() - 5})
+    assert lax.authenticate_token(expired) is None

@@ -12,13 +12,12 @@ fully vectorized — no sequential dsdgen state. Value families
 (distributions, vocabularies, key ranges) follow the TPC-DS spec v2.x;
 the bit-exact dsdgen output is intentionally not reproduced.
 
-Schema subset: the 14 tables on the q64 join graph plus their commonly
-queried columns (store_sales, store_returns, catalog_sales,
-catalog_returns, date_dim, item, customer, customer_address,
-customer_demographics, household_demographics, income_band, promotion,
-store, warehouse). Referential integrity: every foreign key is drawn
-from the referenced table's live key range; returns reference actual
-sales rows by strided index so (item_sk, ticket/order) pairs join.
+Schema: all 24 TPC-DS tables (the three sales/returns channel pairs,
+inventory, and the full dimension set through web_site/ship_mode/
+reason/time_dim) with their commonly queried columns. Referential
+integrity: every foreign key is drawn from the referenced table's live
+key range; returns reference actual sales rows by strided index so
+(item_sk, ticket/order) pairs join.
 """
 
 from __future__ import annotations

@@ -846,6 +846,21 @@ class Executor:
                 from ..columnar import batch_from_pylist
                 return batch_from_pylist(
                     {s: [] for s in node.schema}, dict(node.schema))
+            # reserve-before-allocate for the WORKER's split share too
+            # (same discipline as the whole-table path below): an
+            # oversized fragment fails with the actionable
+            # EXCEEDED_LOCAL_MEMORY_LIMIT error instead of a raw HBM
+            # OOM mid-concat
+            if node.handle.constraint is None \
+                    and node.handle.limit is None \
+                    and hasattr(conn, "table_row_count"):
+                total = conn.table_row_count(node.handle)
+                if total:
+                    share = -(-int(total) * len(mine) // len(splits))
+                    self._reserve(share, len(columns),
+                                  f"worker split share of "
+                                  f"{node.handle.table} "
+                                  f"(part {part}/{nparts})")
             batches = [self._read_split(conn, s, columns)
                        for s in mine]
             whole = (device_concat(batches) if len(batches) > 1

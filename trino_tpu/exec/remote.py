@@ -21,15 +21,16 @@ hosts.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import uuid
 from dataclasses import replace as dc_replace
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 from ..catalog import CatalogManager
 from ..columnar import Batch
-from ..fte.retry import (TASK_RETRIES, RetryController, RetryPolicy,
-                         backoff_delay, pick_worker)
+from ..fte.retry import (COMBINE_RETRIES, TASK_RETRIES, RetryController,
+                         RetryPolicy, backoff_delay, pick_worker)
 from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
                              StragglerDetector)
 from ..plan.nodes import (Aggregate, AggregationNode, FilterNode,
@@ -100,7 +101,9 @@ class RemoteScheduler:
     def __init__(self, worker_uris: List[str],
                  catalogs: CatalogManager, session: Session,
                  collect_stats: bool = False,
-                 failure_detector=None, spool=None):
+                 failure_detector=None, spool=None,
+                 worker_supplier: Optional[
+                     Callable[[], List[str]]] = None):
         if not worker_uris:
             raise ValueError("RemoteScheduler needs at least one worker")
         from ..server.task_worker import RemoteTaskClient
@@ -131,8 +134,44 @@ class RemoteScheduler:
         self.excluded: set = set()
         self._excl_lock = threading.Lock()
         self.task_retries = 0
+        self.combine_retries = 0
         self.speculative_launches = 0
         self.speculative_wins = 0
+        # live membership (server/coordinator.py announce endpoint):
+        # when a supplier is wired, every retry/speculation dispatch
+        # first syncs the worker list, so a worker that JOINS mid-query
+        # becomes eligible for replacement attempts and speculative
+        # duplicates (the initial split fan-out stays fixed — only
+        # extra attempts land on late joiners). Leaves need no sync:
+        # the failure detector's liveness verdict already sidelines
+        # departed workers.
+        self.worker_supplier = worker_supplier
+        self._members_lock = threading.Lock()
+        self._known_uris = {c.base_uri for c in self.workers}
+        self.workers_joined = 0
+
+    def _sync_workers(self) -> None:
+        """Append clients for workers that joined since dispatch.
+        Append-only: positions of known workers never move (attempt
+        rotation in fte/retry.py is positional), and a departed URI
+        keeps its slot for the detector to veto."""
+        if self.worker_supplier is None:
+            return
+        try:
+            uris = list(self.worker_supplier())
+        except Exception:       # noqa: BLE001 — membership is advisory
+            return
+        from ..server.task_worker import RemoteTaskClient
+        with self._members_lock:
+            for u in uris:
+                u = str(u).rstrip("/")
+                if u in self._known_uris:
+                    continue
+                self._known_uris.add(u)
+                self.workers.append(RemoteTaskClient(u))
+                self.workers_joined += 1
+                if self.failure_detector is not None:
+                    self.failure_detector.add_service(u)
 
     # -- fragmentation -------------------------------------------------
     def _remotable(self, node: PlanNode) -> bool:
@@ -284,8 +323,7 @@ class RemoteScheduler:
         final = _substitute(rewritten, {
             f.fid: f.final_builder(_Pre(gathered[f.fid]))
             for f in frags})
-        ex = Executor(self.catalogs, self.session, self.collect_stats)
-        out = ex.execute(final)
+        out, ex = self._execute_combine(final)
         self.peak_memory_bytes = max(self.peak_memory_bytes,
                                      ex.peak_reserved_bytes)
         self.spill_bytes += ex.spilled_bytes
@@ -308,6 +346,47 @@ class RemoteScheduler:
                     self.stats.append(s)
             self.stats.extend(ex.stats)
         return out
+
+    def _execute_combine(self, final: PlanNode):
+        """The root (combine) stage with its own retry loop: under
+        retry_policy=TASK the combine re-executes on the coordinator
+        up to the per-task attempt budget — the fragment output it
+        consumes is already gathered (and, when spooled, durable), so
+        re-running the root costs only coordinator compute. Until PR 6
+        this was the one unretried single point of failure (ROADMAP
+        item 5). A user cancel or a deterministic ``QueryError`` is
+        never retried."""
+        import time as _time
+        policy = RetryPolicy.from_session(self.session)
+        attempts = (max(policy.task_retry_attempts, 1)
+                    if policy.enabled else 1)
+        trace = getattr(self.session, "trace", None)
+        for attempt in range(attempts):
+            ex = Executor(self.catalogs, self.session,
+                          self.collect_stats)
+            t0 = _time.perf_counter()
+            try:
+                return ex.execute(final), ex
+            except Exception as e:      # noqa: BLE001
+                cancel = getattr(self.session, "cancel", None)
+                if cancel is not None and cancel.is_set():
+                    raise
+                if isinstance(e, QueryError):
+                    # deterministic engine/user errors (memory limit,
+                    # bad data at the root) fail identically on every
+                    # attempt — re-running only delays the answer
+                    raise
+                if attempt + 1 >= attempts:
+                    raise
+                self.combine_retries += 1
+                COMBINE_RETRIES.inc()
+                if trace is not None:
+                    trace.record("combine_retry", t0,
+                                 _time.perf_counter(), attempt=attempt,
+                                 error=f"{type(e).__name__}: {e}"[-160:])
+                _time.sleep(backoff_delay(policy, attempt + 1,
+                                          "combine"))
+        raise AssertionError("unreachable")  # loop returns or raises
 
     def _run_fragments(self, frags: List[_Fragment]) -> Dict[int, Batch]:
         """Attempt-aware dispatch: every (fragment, part) task runs a
@@ -336,7 +415,8 @@ class RemoteScheduler:
         use_spool = policy.enabled or speculation_on
         if use_spool and self.spool is None:
             from ..fte.spool import default_spool
-            self.spool = default_spool()
+            self.spool = default_spool(
+                str(session.get("spool_backend")) or None)
         spool = self.spool if use_spool else None
         if spool is not None:
             try:        # ride-along TTL sweep (time-gated internally)
@@ -388,9 +468,11 @@ class RemoteScheduler:
                 # moment a sibling attempt wins (or the user cancels)
                 watch = _MultiEvent(getattr(session, "cancel", None),
                                     st.done)
+                meta: Dict[str, str] = {}
                 frames = client.pages_raw(
                     tid, cancel=watch,
-                    timeout_s=float(session.get("remote_task_timeout")))
+                    timeout_s=float(session.get("remote_task_timeout")),
+                    meta_out=meta)
             except Exception as e:     # noqa: BLE001
                 st.last_window = (t0, _time.perf_counter())
                 if not speculative:
@@ -441,8 +523,34 @@ class RemoteScheduler:
             winner_attempt = attempt
             if spool is not None:
                 try:
-                    winner_attempt = spool.commit(qid, f.fid, st.part,
-                                                  attempt, frames)
+                    # single-host double-write coalescing (PR 5
+                    # follow-on): when the worker already committed
+                    # these exact frames to ITS spool and that
+                    # directory is visible on this host (shared spool
+                    # root), hard-link instead of rewriting the bytes
+                    src_dir = meta.get("spool_dir")
+                    linker = getattr(spool, "commit_linked", None)
+                    winner_attempt = None
+                    if src_dir and linker is not None \
+                            and os.path.isdir(src_dir):
+                        try:
+                            # expect_frames: the header is worker-
+                            # supplied, so the linked bytes must match
+                            # the pulled pages before they can become
+                            # the authoritative spooled output
+                            winner_attempt = linker(
+                                qid, f.fid, st.part, attempt, src_dir,
+                                expect_frames=frames)
+                        except Exception:  # noqa: BLE001
+                            # coalescing is strictly best-effort: a
+                            # reaped source dir or a content mismatch
+                            # falls through to the byte commit of the
+                            # frames actually pulled, instead of
+                            # failing a finished attempt
+                            winner_attempt = None
+                    if winner_attempt is None:
+                        winner_attempt = spool.commit(
+                            qid, f.fid, st.part, attempt, frames)
                 except Exception as e:     # noqa: BLE001 — ENOSPC etc
                     # an unwritable spool is a retriable attempt
                     # failure, not a hung query
@@ -505,6 +613,10 @@ class RemoteScheduler:
             failures = 0
             attempt = st.next_attempt()
             while True:
+                if attempt > 0:
+                    # a replacement attempt may land on a worker that
+                    # joined after dispatch (live membership)
+                    self._sync_workers()
                 with self._excl_lock:
                     banned = frozenset(self.excluded)
                 wi = pick_worker(len(self.workers), st.part, attempt,
@@ -600,6 +712,9 @@ class RemoteScheduler:
                         continue
                     st.speculated = True
                     attempt = st.next_attempt()
+                    # a freshly joined worker is the ideal speculation
+                    # target: idle by definition
+                    self._sync_workers()
                     with self._excl_lock:
                         banned = frozenset(
                             self.excluded
@@ -789,7 +904,9 @@ class DistributedHostQueryRunner:
     def __init__(self, worker_uris: List[str],
                  session: Optional[Session] = None, catalogs=None,
                  collect_node_stats: bool = False,
-                 failure_detector=None, spool=None):
+                 failure_detector=None, spool=None,
+                 worker_supplier: Optional[
+                     Callable[[], List[str]]] = None):
         from ..runner import LocalQueryRunner
         self._local = LocalQueryRunner(session=session,
                                        catalogs=catalogs)
@@ -798,10 +915,14 @@ class DistributedHostQueryRunner:
         self.worker_uris = list(worker_uris)
         self.collect_node_stats = collect_node_stats
         # fault-tolerant execution plumbing (trino_tpu/fte/): both are
-        # optional — the scheduler creates a default LocalDirSpool when
-        # the session asks for retry_policy=TASK and none was given
+        # optional — the scheduler creates a default spool (config/
+        # session-selected backend) when the session asks for
+        # retry_policy=TASK and none was given. ``worker_supplier``
+        # enables live membership: re-polled at retry/speculation time
+        # so late-joining workers receive attempts mid-query.
         self.failure_detector = failure_detector
         self.spool = spool
+        self.worker_supplier = worker_supplier
 
     def execute(self, sql: str):
         import time as _time
@@ -845,7 +966,8 @@ class DistributedHostQueryRunner:
                 self.worker_uris, self.catalogs, self.session,
                 collect_stats=collect,
                 failure_detector=self.failure_detector,
-                spool=self.spool)
+                spool=self.spool,
+                worker_supplier=self.worker_supplier)
             with sp("execute"):
                 batch = sched.execute_plan(plan)
         finally:

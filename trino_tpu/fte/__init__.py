@@ -16,13 +16,17 @@ a retry or a speculative re-dispatch is discarded, never double-counted
 — and the coordinator combine reads the spool, not per-thread memory.
 """
 
+from .objectstore import (InMemoryObjectStore, ObjectStore,
+                          ObjectStoreSpool, TransientObjectStoreError)
 from .retry import (RETRY_NONE, RETRY_TASK, RetryController, RetryPolicy,
                     backoff_delay, pick_worker)
 from .speculate import StragglerDetector
-from .spool import LocalDirSpool, SpoolManager
+from .spool import LocalDirSpool, SpoolManager, default_spool, make_spool
 
 __all__ = [
     "RETRY_NONE", "RETRY_TASK", "RetryController", "RetryPolicy",
     "backoff_delay", "pick_worker", "StragglerDetector",
-    "LocalDirSpool", "SpoolManager",
+    "LocalDirSpool", "SpoolManager", "make_spool", "default_spool",
+    "ObjectStore", "ObjectStoreSpool", "InMemoryObjectStore",
+    "TransientObjectStoreError",
 ]

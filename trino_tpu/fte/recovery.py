@@ -1,0 +1,218 @@
+"""Coordinator result recovery: spooled combine output + query manifest.
+
+Reference parity: Trino's fault-tolerant execution spools the ROOT
+stage's output too (the coordinator's exchange sink writes to the
+exchange manager like any other stage), which is what lets a client
+re-pull `QueryResults` pages after the coordinator restarts — the query
+is finished, its pages are durable, only the serving process died.
+
+Here the combine (root) stage's output — the final client-visible rows
+— plus a minimal manifest (query id, slug, SQL, user, column names and
+type names, update metadata) is committed to the shared spool under a
+RESERVED fragment id, keyed by the COORDINATOR's query id. A restarted
+coordinator that gets `GET /v1/statement/executing/{id}/{slug}/{token}`
+for a query it has never heard of loads the manifest off the spool,
+verifies the slug, rebuilds a FINISHED query entry, and serves the
+pages as if it had run the query itself. The recovery window is the
+spool TTL.
+
+Rows are persisted in the client WIRE encoding (dates/decimals already
+JSON-stringified): the recovered pages are byte-for-byte what the
+original coordinator would have served, and no engine type machinery is
+needed to read them back.
+"""
+
+from __future__ import annotations
+
+import datetime
+import decimal
+import json
+from dataclasses import dataclass
+from typing import List, Optional
+
+from ..obs.metrics import METRICS
+
+# fragment ids from the planner are >= 0; the query's final result
+# spools under this reserved id (layout: <query_id>/f-1.p0/...)
+RESULT_FRAGMENT = -1
+
+# rows per persisted result frame — matches the coordinator's
+# QueryResults paging so one frame serves ~one client page
+RESULT_PAGE_ROWS = 4096
+
+_M_RESULTS_PERSISTED = METRICS.counter(
+    "trino_tpu_query_results_spooled_total",
+    "Finished queries whose results + manifest were spooled for "
+    "coordinator-restart recovery")
+_M_RESULTS_RECOVERED = METRICS.counter(
+    "trino_tpu_query_results_recovered_total",
+    "Queries rebuilt from the spooled manifest by a coordinator that "
+    "did not run them (restart recovery)")
+_M_RESULTS_SKIPPED = METRICS.counter(
+    "trino_tpu_query_results_spool_skipped_total",
+    "Finished queries whose results exceeded result_spool_max_bytes "
+    "and were not persisted for restart recovery")
+
+
+def json_value(v):
+    """Client wire encoding of one value (QueryResults data cell)."""
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat(sep=" ") if isinstance(v, datetime.datetime) \
+            else v.isoformat()
+    if isinstance(v, decimal.Decimal):
+        return str(v)
+    return v
+
+
+class _NamedType:
+    """Type stand-in for recovered results: the serving path only needs
+    ``.name`` (column rendering), never the engine type machinery."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __repr__(self):
+        return f"_NamedType({self.name!r})"
+
+
+@dataclass
+class RecoveredQuery:
+    """A finished query reloaded from its spooled manifest."""
+    query_id: str
+    slug: str
+    sql: str
+    user: str
+    columns: List[str]
+    type_names: List[str]
+    rows: List[list]
+    update_type: Optional[str] = None
+    update_count: Optional[int] = None
+
+    def to_query_result(self):
+        from ..runner import QueryResult
+        res = QueryResult(list(self.columns),
+                          [_NamedType(n) for n in self.type_names],
+                          self.rows, query_id=self.query_id)
+        res.update_type = self.update_type
+        res.update_count = self.update_count
+        return res
+
+
+class ResultStore:
+    """Persists / recovers finished query results through a
+    ``SpoolManager`` (any backend)."""
+
+    def __init__(self, spool):
+        self.spool = spool
+
+    def persist(self, query_id: str, slug: str, sql: str, user: str,
+                result, max_bytes: Optional[int] = None) -> bool:
+        """Spool a finished query's manifest + wire-encoded result
+        pages. Best-effort by contract: the query already succeeded,
+        so a failed persist costs only restart recoverability.
+
+        ``max_bytes`` (default CONFIG.result_spool_max_bytes) bounds
+        the encoded size: the persist runs ON the query thread before
+        FINISHED is client-visible (durability precedes publication),
+        so an unbounded result would add O(result) latency and a
+        second in-memory copy — past the cap the query simply isn't
+        restart-recoverable, like every query before PR 6."""
+        if max_bytes is None:
+            from ..config import CONFIG
+            max_bytes = int(CONFIG.result_spool_max_bytes)
+        ncols = len(result.columns or []) or 1
+        # floor-estimate before paying the wire re-encode: every row
+        # costs at least "[v,…]," = 2 bytes per cell + brackets
+        if max_bytes > 0 and len(result.rows) * (2 * ncols + 2) \
+                > max_bytes:
+            _M_RESULTS_SKIPPED.inc()
+            return False
+        rows = [[json_value(v) for v in row] for row in result.rows]
+        manifest = {
+            "queryId": query_id,
+            "slug": slug,
+            "sql": sql,
+            "user": user,
+            "columns": list(result.columns or []),
+            "types": [t.name for t in (result.types or [])],
+            "rows": len(rows),
+            "updateType": result.update_type,
+            "updateCount": result.update_count,
+        }
+        frames = [json.dumps(manifest).encode()]
+        total = len(frames[0])
+        for lo in range(0, len(rows), RESULT_PAGE_ROWS):
+            frame = json.dumps(rows[lo:lo + RESULT_PAGE_ROWS]).encode()
+            total += len(frame)
+            if max_bytes > 0 and total > max_bytes:
+                _M_RESULTS_SKIPPED.inc()
+                return False
+            frames.append(frame)
+        try:
+            self.spool.commit(query_id, RESULT_FRAGMENT, 0, 0, frames)
+        except Exception:       # noqa: BLE001 — durable results are
+            return False        # opportunistic, never a query failure
+        _M_RESULTS_PERSISTED.inc()
+        return True
+
+    def load_manifest(self, query_id: str) -> Optional[dict]:
+        """Read ONLY the manifest (frame 0) — the cheap peek callers
+        use to verify the slug before paying for the full row decode
+        (a wrong-slug probe must not re-read a 64MB result to 404)."""
+        try:
+            raw = self.spool.read_frame(query_id, RESULT_FRAGMENT, 0, 0)
+        except Exception:       # noqa: BLE001
+            return None
+        if raw is None:
+            return None
+        try:
+            mf = json.loads(raw)
+        except ValueError:
+            return None
+        return mf if isinstance(mf, dict) else None
+
+    def load(self, query_id: str,
+             slug: Optional[str] = None) -> Optional[RecoveredQuery]:
+        """Reload a query's manifest + rows, or None if nothing (or
+        something unreadable) is spooled under its id. When ``slug``
+        is given it is checked against the manifest BEFORE the row
+        frames are read."""
+        if slug is not None:
+            mf = self.load_manifest(query_id)
+            if mf is None or str(mf.get("slug")) != slug:
+                return None
+        try:
+            frames = self.spool.read(query_id, RESULT_FRAGMENT, 0)
+        except Exception:       # noqa: BLE001
+            return None
+        if not frames:
+            return None
+        try:
+            manifest = json.loads(frames[0])
+            rows: List[list] = []
+            for fr in frames[1:]:
+                rows.extend(json.loads(fr))
+            if len(rows) != int(manifest.get("rows", len(rows))):
+                return None     # torn manifest: refuse a partial answer
+            rec = RecoveredQuery(
+                query_id=str(manifest["queryId"]),
+                slug=str(manifest["slug"]),
+                sql=str(manifest.get("sql", "")),
+                user=str(manifest.get("user", "")),
+                columns=list(manifest.get("columns") or []),
+                type_names=list(manifest.get("types") or []),
+                rows=rows,
+                update_type=manifest.get("updateType"),
+                update_count=manifest.get("updateCount"),
+            )
+        except (KeyError, ValueError, TypeError):
+            return None
+        return rec
+
+    def release(self, query_id: str) -> None:
+        try:
+            self.spool.release(query_id)
+        except Exception:       # noqa: BLE001
+            pass

@@ -32,6 +32,14 @@ TASK_RETRIES = METRICS.counter(
     "trino_tpu_task_retries_total",
     "Remote task attempts re-dispatched after a failure")
 
+# the root stage has no worker to rotate to — it re-executes on the
+# coordinator over the spooled fragment output (exec/remote.py
+# _execute_combine); until PR 6 it was the one unretried stage
+COMBINE_RETRIES = METRICS.counter(
+    "trino_tpu_combine_retries_total",
+    "Coordinator combine (root) stage executions retried after a "
+    "failure")
+
 
 @dataclass(frozen=True)
 class RetryPolicy:

@@ -42,10 +42,23 @@ _M_SPOOL_READ = METRICS.counter(
 _M_SPOOL_DUPES = METRICS.counter(
     "trino_tpu_spool_duplicate_attempts_total",
     "Late duplicate task attempts discarded by first-commit-wins")
+_M_SPOOL_COALESCED = METRICS.counter(
+    "trino_tpu_spool_coalesced_commits_total",
+    "Spool commits satisfied by hard-linking the worker's already-"
+    "spooled frames (single-host double-write coalescing)")
 
 
 class SpoolManager:
-    """Pluggable spool interface (the ExchangeManager SPI analog)."""
+    """Pluggable spool interface (the ExchangeManager SPI analog).
+    Backends: ``LocalDirSpool`` (single-host durable directory tree,
+    below) and ``ObjectStoreSpool`` (fte/objectstore.py — S3/GCS-shaped
+    put/get/list/delete with bounded retries, for real multi-host
+    durability). ``make_spool`` selects one by name."""
+
+    # backends set these in __init__; the time-gated sweep below is
+    # shared so every backend's full scan runs at most once per TTL/4
+    ttl_s: float = 3600.0
+    _last_sweep: float = 0.0
 
     def commit(self, query_id: str, fragment_id: int, part: int,
                attempt: int, frames: List[bytes]) -> int:
@@ -70,23 +83,82 @@ class SpoolManager:
         """Reap expired query spools; returns how many were removed."""
         raise NotImplementedError
 
+    def maybe_cleanup(self, now: Optional[float] = None) -> int:
+        """Time-gated ``cleanup``: the full sweep scans every query
+        under the base, so callers on a dispatch hot path run it at
+        most once per TTL/4 (floor 60s)."""
+        now = time.time() if now is None else now
+        gate = max(min(self.ttl_s / 4, 900.0), 60.0)
+        if now - self._last_sweep < gate:
+            return 0
+        self._last_sweep = now
+        return self.cleanup(now)
 
-_DEFAULT: Optional["LocalDirSpool"] = None
+    # released-query tombstones, shared by every backend: a commit
+    # arriving AFTER release (a straggler attempt of a finished query)
+    # must be dropped, not resurrect the spool. The set is created
+    # lazily per instance so a backend implementing only the abstract
+    # surface never has to know about it (a class-level set would be
+    # shared across every spool in the process).
+    def _is_released(self, query_id: str) -> bool:
+        return str(query_id) in getattr(self, "_released", ())
+
+    def _mark_released(self, query_id: str) -> None:
+        released = getattr(self, "_released", None)
+        if released is None:
+            released = self._released = set()
+        released.add(str(query_id))
+        if len(released) > 4096:
+            # bounded memory; the TTL sweep backstops anything a
+            # forgotten tombstone lets through
+            released.clear()
+            released.add(str(query_id))
+
+
+_DEFAULTS: dict = {}
 _DEFAULT_LOCK = threading.Lock()
 
 
-def default_spool() -> "LocalDirSpool":
-    """Process-wide ``LocalDirSpool`` for schedulers not handed one
-    explicitly. Sharing one instance keeps the time-gated TTL sweep
-    (``maybe_cleanup``) at its intended once-per-TTL/4 cadence — a
-    fresh spool per query would reset ``_last_sweep`` and pay a full
-    directory scan on every dispatch. Config is read once, at first
-    use."""
-    global _DEFAULT
+def make_spool(backend: Optional[str] = None,
+               local_base_dir: Optional[str] = None,
+               **kwargs) -> SpoolManager:
+    """Backend factory (config/session-selected; the ExchangeManager
+    plugin-loading analog): ``local`` (default) is the directory-tree
+    spool; ``memory`` is the object-store code path over the in-memory
+    emulation — the single-process stand-in for an S3/GCS bucket (a
+    real bucket client slots in by implementing the ObjectStore
+    surface). ``local_base_dir`` overrides the local backend's
+    directory and is ignored by directory-less backends, so callers
+    with a role-scoped dir (the worker's ``-worker`` suffix) need not
+    duplicate the backend-alias resolution."""
+    from ..config import CONFIG
+    name = (backend or CONFIG.spool_backend or "local").lower()
+    if name in ("local", "filesystem", ""):
+        if local_base_dir is not None:
+            kwargs.setdefault("base_dir", local_base_dir)
+        return LocalDirSpool(**kwargs)
+    if name in ("memory", "objectstore"):
+        from .objectstore import InMemoryObjectStore, ObjectStoreSpool
+        return ObjectStoreSpool(InMemoryObjectStore(), **kwargs)
+    raise ValueError(f"unknown spool backend '{backend}' "
+                     "(expected 'local' or 'memory')")
+
+
+def default_spool(backend: Optional[str] = None) -> SpoolManager:
+    """Process-wide spool singleton per backend name, for schedulers
+    not handed one explicitly. Sharing one instance keeps the
+    time-gated TTL sweep (``maybe_cleanup``) at its intended
+    once-per-TTL/4 cadence — a fresh spool per query would reset
+    ``_last_sweep`` and pay a full scan on every dispatch — and, for
+    the in-memory object store, keeps every query in the SAME store.
+    Config is read once, at first use."""
+    from ..config import CONFIG
+    name = (backend or CONFIG.spool_backend or "local").lower()
     with _DEFAULT_LOCK:
-        if _DEFAULT is None:
-            _DEFAULT = LocalDirSpool()
-        return _DEFAULT
+        spool = _DEFAULTS.get(name)
+        if spool is None:
+            spool = _DEFAULTS[name] = make_spool(name)
+        return spool
 
 
 class LocalDirSpool(SpoolManager):
@@ -121,7 +193,7 @@ class LocalDirSpool(SpoolManager):
     # -- SpoolManager --------------------------------------------------
     def commit(self, query_id: str, fragment_id: int, part: int,
                attempt: int, frames: List[bytes]) -> int:
-        if str(query_id) in self._released:
+        if self._is_released(query_id):
             return attempt   # query already finished: drop, do not
             #                  resurrect the released dir
         tdir = self._task_dir(query_id, fragment_id, part)
@@ -132,6 +204,89 @@ class LocalDirSpool(SpoolManager):
             with open(os.path.join(tmp, f"page_{i:05d}.bin"),
                       "wb") as f:
                 f.write(frame)
+        return self._seal_attempt(query_id, fragment_id, part, attempt,
+                                  tmp, sum(len(f) for f in frames))
+
+    def commit_linked(self, query_id: str, fragment_id: int, part: int,
+                      attempt: int, src_dir: str,
+                      expect_frames: Optional[List[bytes]] = None) -> int:
+        """Commit by HARD-LINKING an already-spooled attempt directory
+        (the worker's task spool on the same host) instead of rewriting
+        the frame bytes — the single-host double-write coalescing of
+        the worker/coordinator spool pair. Hard links (not symlinks):
+        the worker's TTL sweep reaping its own dir only unlinks names,
+        the shared inodes survive under our layout. Falls back to a
+        byte copy on cross-device links.
+
+        ``expect_frames`` verifies the linked bytes: ``src_dir`` comes
+        from a worker-supplied header (X-TT-Spool-Dir), and with a
+        spool active the gather reads frames OFF the spool — so the
+        linked files, not the pulled pages, become the authoritative
+        combine input. Verification happens AFTER linking (so a
+        rename-swap between check and link is impossible); a mismatch
+        raises ``ValueError`` and nothing is published, letting the
+        caller fall back to the byte commit of the pages it actually
+        pulled. What this guards against is linking FOREIGN files —
+        a stale or hostile path whose contents differ from the pulled
+        pages. It deliberately does not defend against the worker
+        later rewriting its own frames through the shared inode: the
+        worker authored those bytes and could as easily have served
+        the altered version as pages, so that is no new capability —
+        a worker you cannot trust with its own output needs the
+        object-store backend, not link coalescing. Reading back bytes
+        the worker just wrote (page-cache hot) still beats re-writing
+        them."""
+        if self._is_released(query_id):
+            return attempt
+        tdir = self._task_dir(query_id, fragment_id, part)
+        adir = os.path.join(tdir, f"a{attempt}")
+        tmp = f"{adir}.tmp{os.getpid()}.{threading.get_ident()}"
+        os.makedirs(tmp, exist_ok=True)
+        try:
+            names = sorted(os.listdir(src_dir))
+            if expect_frames is not None \
+                    and len(names) != len(expect_frames):
+                raise ValueError(
+                    f"coalescing source {src_dir} has {len(names)} "
+                    f"frames, pulled {len(expect_frames)}")
+            copied_bytes = 0
+            for name in names:
+                src = os.path.join(src_dir, name)
+                dst = os.path.join(tmp, name)
+                try:
+                    os.link(src, dst)
+                except OSError:
+                    # cross-device (EXDEV etc): physically re-written
+                    # bytes must show in the written counter — and the
+                    # commit must NOT be reported as coalesced
+                    shutil.copyfile(src, dst)
+                    copied_bytes += os.path.getsize(dst)
+            if expect_frames is not None:
+                for name, frame in zip(names, expect_frames):
+                    with open(os.path.join(tmp, name), "rb") as f:
+                        if f.read() != frame:
+                            raise ValueError(
+                                f"coalescing source {src_dir}/{name} "
+                                "does not match the pulled frame")
+        except Exception:
+            shutil.rmtree(tmp, ignore_errors=True)
+            raise
+        won = self._seal_attempt(query_id, fragment_id, part, attempt,
+                                 tmp, written_bytes=copied_bytes)
+        if won == attempt and copied_bytes == 0:
+            # counted only when this attempt actually owns the marker
+            # AND every frame was truly linked: a coalesced loser is a
+            # discarded duplicate, and a cross-device copy fallback is
+            # a real double write, not a coalesced commit
+            _M_SPOOL_COALESCED.inc()
+        return won
+
+    def _seal_attempt(self, query_id: str, fragment_id: int, part: int,
+                      attempt: int, tmp: str, written_bytes: int) -> int:
+        """Atomically publish a fully written attempt temp dir and race
+        for the COMMITTED marker (first-commit-wins)."""
+        tdir = self._task_dir(query_id, fragment_id, part)
+        adir = os.path.join(tdir, f"a{attempt}")
         try:
             os.rename(tmp, adir)
         except OSError:
@@ -155,7 +310,7 @@ class LocalDirSpool(SpoolManager):
             for _ in range(2):
                 try:
                     os.link(tmpm, marker)
-                    _M_SPOOL_WRITTEN.inc(sum(len(f) for f in frames))
+                    _M_SPOOL_WRITTEN.inc(written_bytes)
                     return attempt
                 except FileExistsError:
                     winner = self.committed_attempt(
@@ -241,26 +396,21 @@ class LocalDirSpool(SpoolManager):
         _M_SPOOL_READ.inc(len(data))
         return data
 
+    def attempt_dir(self, query_id: str, fragment_id: int,
+                    part: int) -> Optional[str]:
+        """Absolute directory of the COMMITTED attempt's frames, or
+        None — the handle a same-host consumer needs to coalesce its
+        own commit into hard links (``commit_linked``)."""
+        attempt = self.committed_attempt(query_id, fragment_id, part)
+        if attempt is None:
+            return None
+        return os.path.join(self._task_dir(query_id, fragment_id, part),
+                            f"a{attempt}")
+
     def release(self, query_id: str) -> None:
-        self._released.add(str(query_id))
-        if len(self._released) > 4096:
-            # bounded memory; the TTL sweep backstops anything a
-            # forgotten tombstone lets through
-            self._released.clear()
-            self._released.add(str(query_id))
+        self._mark_released(query_id)
         shutil.rmtree(os.path.join(self.base, str(query_id)),
                       ignore_errors=True)
-
-    def maybe_cleanup(self, now: Optional[float] = None) -> int:
-        """Time-gated ``cleanup``: the full sweep stats every query dir
-        under the base, so callers on a dispatch hot path run it at
-        most once per TTL/4 (floor 60s)."""
-        now = time.time() if now is None else now
-        gate = max(min(self.ttl_s / 4, 900.0), 60.0)
-        if now - self._last_sweep < gate:
-            return 0
-        self._last_sweep = now
-        return self.cleanup(now)
 
     def cleanup(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now

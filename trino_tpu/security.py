@@ -233,11 +233,17 @@ class JwtAuthenticator(TokenAuthenticator):
 
     def __init__(self, secret: bytes, principal_field: str = "sub",
                  required_audience: Optional[str] = None,
-                 required_issuer: Optional[str] = None):
+                 required_issuer: Optional[str] = None,
+                 require_exp: bool = True):
         self.secret = secret
         self.principal_field = principal_field
         self.required_audience = required_audience
         self.required_issuer = required_issuer
+        # a token without exp can never age out, so a leaked one is a
+        # permanent credential; reject by default (require_exp=False
+        # restores the legacy accept-forever behavior for internal
+        # mint-on-boot tokens)
+        self.require_exp = require_exp
 
     @staticmethod
     def _b64url_decode(part: str) -> bytes:
@@ -281,7 +287,10 @@ class JwtAuthenticator(TokenAuthenticator):
             if not isinstance(claims, dict):
                 return None
             exp = claims.get("exp")
-            if exp is not None and _time.time() > float(exp):
+            if exp is None:
+                if self.require_exp:
+                    return None
+            elif _time.time() > float(exp):
                 return None
             nbf = claims.get("nbf")
             if nbf is not None and _time.time() < float(nbf):

@@ -15,8 +15,6 @@ multi-host DCN dispatch is the designed extension point).
 
 from __future__ import annotations
 
-import datetime
-import decimal
 import itertools
 import json
 import threading
@@ -43,15 +41,19 @@ _M_DETAIL_PLAN_ERRORS = METRICS.counter(
     "trino_tpu_query_detail_plan_errors_total",
     "Failures re-deriving a plan for /v1/query/{id} (legacy fallback "
     "path; the plan is normally captured at execution time)")
+# live worker membership (the discovery-service join/leave surface)
+_M_WORKER_JOINS = METRICS.counter(
+    "trino_tpu_worker_joins_total",
+    "Workers added to the active set via /v1/announcement")
+_M_WORKER_LEAVES = METRICS.counter(
+    "trino_tpu_worker_leaves_total",
+    "Workers removed from the active set via /v1/announcement")
 
-
-def _json_value(v):
-    if isinstance(v, (datetime.date, datetime.datetime)):
-        return v.isoformat(sep=" ") if isinstance(v, datetime.datetime) \
-            else v.isoformat()
-    if isinstance(v, decimal.Decimal):
-        return str(v)
-    return v
+# one wire encoding for live serving and spooled-result persistence —
+# a recovered page must be byte-for-byte what the original coordinator
+# would have served (fte/recovery.py owns the definition)
+from ..fte.recovery import _M_RESULTS_RECOVERED  # noqa: E402
+from ..fte.recovery import json_value as _json_value  # noqa: E402
 
 
 @dataclass
@@ -83,7 +85,7 @@ class _Query:
             self.state = new_state
             return True
 
-    def run(self, runner_factory):
+    def run(self, runner_factory, on_result=None, on_discard=None):
         if not self._transition("RUNNING"):
             return
         # the executor polls this event between plan nodes, so cancel
@@ -92,8 +94,28 @@ class _Query:
         try:
             runner = runner_factory(self.session)
             result = runner.execute(self.sql)
+            persisted = False
+            if on_result is not None and self.state == "RUNNING":
+                # durability-before-publication: the restart-recovery
+                # persist completes BEFORE any client can observe
+                # FINISHED, so "the client saw the query finish"
+                # implies "its results are re-pullable". Skipped once
+                # a cancel landed — a CANCELED query's results must
+                # never become recoverable-as-FINISHED.
+                try:
+                    persisted = bool(on_result(self, result))
+                except Exception:        # noqa: BLE001 — best-effort
+                    pass
             if self._transition("FINISHED"):
                 self.result = result
+            elif persisted and on_discard is not None:
+                # cancel raced the persist between the state check and
+                # the transition: the query ends CANCELED, so the
+                # just-spooled results must not outlive it
+                try:
+                    on_discard(self)
+                except Exception:        # noqa: BLE001
+                    pass
         except Exception as e:   # error taxonomy: Appendix A.8
             if self._cancel.is_set() or not self._transition("FAILED"):
                 return
@@ -131,7 +153,8 @@ class QueryTracker:
     dispatcher/DispatchManager.java:183 selectGroup) and emits
     lifecycle events (event/QueryMonitor.java:130,206)."""
 
-    def __init__(self, make_runner, events=None, resource_groups=None):
+    def __init__(self, make_runner, events=None, resource_groups=None,
+                 result_store=None):
         from .events import EventListenerManager
         self._queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
@@ -139,6 +162,10 @@ class QueryTracker:
         self._make_runner = make_runner
         self.events = events or EventListenerManager()
         self.groups = resource_groups
+        # coordinator-restart recovery (fte/recovery.py): finished
+        # queries persist their combine output + manifest here so a
+        # client can re-pull results from a NEW coordinator process
+        self.results = result_store
 
     def submit(self, sql: str, session: Session,
                source: str = "") -> _Query:
@@ -170,14 +197,40 @@ class QueryTracker:
                 timer.daemon = True
                 timer.start()
             _M_STATES.inc(state="RUNNING")
+            persist = discard = None
+            if self.results is not None:
+                def persist(query, result):
+                    # durable results: spool the combine output + a
+                    # minimal manifest so a restarted coordinator can
+                    # serve this query's re-pulls
+                    return self.results.persist(
+                        query.query_id, query.slug, query.sql,
+                        query.session.user, result)
+
+                def discard(query):
+                    # cancel won the race against the persist: reap
+                    # the entry so it cannot be recovered as FINISHED
+                    self.results.release(query.query_id)
             try:
-                q.run(self._make_runner)
+                q.run(self._make_runner, on_result=persist,
+                      on_discard=discard)
             finally:
                 if timer is not None:
                     timer.cancel()
                 if q.group is not None and self.groups is not None:
                     self.groups.query_finished(q.group)
                 _M_STATES.inc(state=q.state)
+                if self.results is not None:
+                    try:
+                        # ride-along TTL sweep (time-gated internally):
+                        # clients don't DELETE fully-drained queries,
+                        # so without this the persisted results of
+                        # retry_policy=NONE queries — whose dispatch
+                        # path never touches the spool — would pile up
+                        # forever
+                        self.results.spool.maybe_cleanup()
+                    except Exception:    # noqa: BLE001
+                        pass
                 r = q.result
                 stats = (getattr(r, "stats", None) or []) if r else []
                 cum = None
@@ -263,7 +316,8 @@ class Coordinator:
     def __init__(self, port: int = 0, distributed: bool = False,
                  catalogs=None, resource_groups=None,
                  event_listeners=None, authenticator=None,
-                 worker_uris=None, failure_detector=None):
+                 worker_uris=None, failure_detector=None,
+                 spool=None, spool_backend: Optional[str] = None):
         from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
@@ -272,13 +326,17 @@ class Coordinator:
         self.authenticator = authenticator
         # remote worker fleet: queries dispatch leaf fragments to these
         # processes (exec/remote.py; reference: DiscoveryNodeManager's
-        # active worker set feeding SqlQueryScheduler)
-        self.workers = list(worker_uris or [])
+        # active worker set feeding SqlQueryScheduler). Membership is
+        # LIVE: workers join/leave at runtime through /v1/announcement
+        # (add_worker/remove_worker below), guarded by one lock.
+        self.workers = [str(w).rstrip("/") for w in (worker_uris or [])]
+        self._members_lock = threading.Lock()
         # fault-tolerant execution (trino_tpu/fte/): one failure
         # detector and one spool shared by every query. The default
         # detector is feedback-driven (schedulers report observed task
         # failures); call failure_detector.start() to add the active
-        # heartbeat loop (server/main.py does for configured fleets).
+        # heartbeat loop (server/main.py does for configured fleets;
+        # add_worker starts it for fleets born empty).
         self.failure_detector = failure_detector
         if self.failure_detector is None and self.workers:
             from .failure import HeartbeatFailureDetector
@@ -286,10 +344,20 @@ class Coordinator:
         if self.failure_detector is not None:
             for w in self.workers:
                 self.failure_detector.add_service(w)
-        self.spool = None
-        if self.workers:
-            from ..fte.spool import LocalDirSpool
-            self.spool = LocalDirSpool()
+        # the spool (backend per config/arg — fte/spool.py make_spool)
+        # carries fragment output for fault-tolerant queries AND the
+        # finished-query results that make coordinator restarts
+        # survivable; an explicit ``spool`` enables recovery even for
+        # a workerless (single-node) coordinator
+        self.spool = spool
+        if self.spool is None and (self.workers
+                                   or spool_backend is not None):
+            from ..fte.spool import make_spool
+            self.spool = make_spool(spool_backend)
+        self.results = None
+        if self.spool is not None:
+            from ..fte.recovery import ResultStore
+            self.results = ResultStore(self.spool)
 
         # one shared CatalogManager (memory-connector state spans
         # queries) and one shared mesh
@@ -301,15 +369,27 @@ class Coordinator:
         self._catalogs.register("system", SystemConnector(self))
 
         def make_runner(session: Session):
-            detector = getattr(self, "failure_detector", None)
-            live = [w for w in self.workers
-                    if detector is None or detector.is_alive(w)]
+            live = self.live_workers()
             if live:
                 from ..exec.remote import DistributedHostQueryRunner
+                # SET SESSION spool_backend overrides the server's
+                # fragment spool for this query (result persistence
+                # stays on the server spool — recovery durability is a
+                # coordinator property, not a per-query choice)
+                backend = str(session.get("spool_backend") or "")
+                spool = self.spool
+                if backend:
+                    from ..fte.spool import default_spool
+                    spool = default_spool(backend)
                 return DistributedHostQueryRunner(
                     live, session=session, catalogs=self._catalogs,
                     collect_node_stats=True,
-                    failure_detector=detector, spool=self.spool)
+                    failure_detector=self.failure_detector,
+                    spool=spool,
+                    # live membership: mid-query joins become retry /
+                    # speculation targets (exec/remote.py syncs this
+                    # before every replacement dispatch)
+                    worker_supplier=self.live_workers)
             # per-node wall/row stats feed the web UI's query detail
             # (OperatorStats is always-on in the reference coordinator)
             return LocalQueryRunner(session=session,
@@ -322,7 +402,8 @@ class Coordinator:
             events.add_listener(listener)
         self.resource_groups = resource_groups
         self.tracker = QueryTracker(make_runner, events,
-                                    resource_groups)
+                                    resource_groups,
+                                    result_store=self.results)
         self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
@@ -380,6 +461,121 @@ class Coordinator:
         if self.failure_detector is not None:
             self.failure_detector.stop()
         self._httpd.shutdown()
+
+    # ---- live worker membership --------------------------------------
+    def live_workers(self) -> List[str]:
+        """Current worker set minus nodes the failure detector reports
+        dead — the per-dispatch view the schedulers consume."""
+        detector = self.failure_detector
+        with self._members_lock:
+            workers = list(self.workers)
+        return [w for w in workers
+                if detector is None or detector.is_alive(w)]
+
+    def add_worker(self, uri: str) -> bool:
+        """Join a worker at runtime (/v1/announcement POST; reference:
+        DiscoveryNodeManager absorbing a service announcement). A
+        joining worker immediately becomes a retry / speculation
+        target for in-flight queries and a full member for new ones.
+        Idempotent: re-announcement of a known worker is a no-op."""
+        uri = str(uri).rstrip("/")
+        if not uri:
+            return False
+        with self._members_lock:
+            # the whole join — membership, detector/spool bootstrap —
+            # runs under the lock: concurrent first announcements must
+            # not construct two detectors (a worker registered in the
+            # discarded one would never be heartbeat-probed)
+            if uri in self.workers:
+                return False
+            self.workers.append(uri)
+            if self.failure_detector is None:
+                from .failure import HeartbeatFailureDetector
+                self.failure_detector = HeartbeatFailureDetector()
+            self.failure_detector.add_service(uri)
+            # a fleet born empty never started its heartbeat loop;
+            # start() is idempotent for one already running
+            self.failure_detector.start()
+            if self.spool is None:
+                # first worker ever: the cluster just became
+                # distributed — it needs the spool (and with it
+                # restart recovery)
+                from ..fte.recovery import ResultStore
+                from ..fte.spool import make_spool
+                self.spool = make_spool()
+                self.results = ResultStore(self.spool)
+                self.tracker.results = self.results
+        _M_WORKER_JOINS.inc()
+        return True
+
+    def remove_worker(self, uri: str) -> bool:
+        """Graceful leave (/v1/announcement DELETE). Ungraceful deaths
+        need no call — the heartbeat detector sidelines them and the
+        retry engine routes around (PR 5)."""
+        uri = str(uri).rstrip("/")
+        with self._members_lock:
+            if uri not in self.workers:
+                return False
+            self.workers.remove(uri)
+        if self.failure_detector is not None:
+            self.failure_detector.remove_service(uri)
+        _M_WORKER_LEAVES.inc()
+        return True
+
+    # ---- coordinator-restart result recovery -------------------------
+    def recover_query(self, query_id: str,
+                      slug: Optional[str] = None) -> Optional[_Query]:
+        """Rebuild a FINISHED query this process never ran from its
+        spooled manifest + result pages (fte/recovery.py) — the serving
+        half of coordinator restart tolerance. ``slug`` (when the
+        client supplied one) must match the manifest: the slug is the
+        per-query capability token, and a restart must not weaken it."""
+        if self.results is None:
+            return None
+        # slug checked against the manifest alone (load_manifest)
+        # before the row frames are decoded: a wrong-slug probe 404s
+        # without re-reading the whole persisted result
+        rec = self.results.load(query_id, slug)
+        if rec is None or (slug is not None and rec.slug != slug):
+            return None
+        q = _Query(query_id, rec.slug, rec.sql,
+                   Session(user=rec.user or "user"))
+        q.state = "FINISHED"
+        q.result = rec.to_query_result()
+        q.ended = time.time()
+        q._done.set()
+        with self.tracker._lock:
+            # first-registration-wins: a concurrent recovery (two
+            # clients re-pulling at once) must serve ONE entry
+            registered = self.tracker._queries.setdefault(query_id, q)
+        if registered is q:
+            # counted here, not in ResultStore.load: a slug-mismatch
+            # probe or a losing concurrent load is not a recovery
+            _M_RESULTS_RECOVERED.inc()
+        return registered
+
+    def recovered_query_detail(self, query_id: str) -> Optional[dict]:
+        """Manifest-only detail for an untracked query — the slug-less
+        /v1/query/{id} surface. Full recovery (recover_query) decodes
+        every persisted row frame and pins it in the tracker, which a
+        request that presents no slug and needs only metadata must not
+        trigger: probed ids would pin N x result_spool_max_bytes of
+        rows in a process that never ran them."""
+        if self.results is None:
+            return None
+        mf = self.results.load_manifest(query_id)
+        if mf is None:
+            return None
+        return {
+            "queryId": str(mf.get("queryId", query_id)),
+            "state": "FINISHED",
+            "query": str(mf.get("sql", "")),
+            "user": str(mf.get("user", "")),
+            "source": "",
+            "error": None,
+            "rows": int(mf.get("rows") or 0),
+            "recovered": True,
+        }
 
     # ---- resource payloads -------------------------------------------
     def query_results(self, q: _Query, token: int) -> dict:
@@ -753,6 +949,23 @@ def _make_handler(co: Coordinator):
                 q.wait_done(0.05)   # fast queries answer immediately
                 self._send(200, co.query_results(q, 0))
                 return
+            if path == "/v1/announcement":
+                # worker join (discovery-service announcement analog);
+                # idempotent, so workers re-announce on a cadence
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                    uri = str(body.get("uri", "")).strip() \
+                        if isinstance(body, dict) else ""
+                except (ValueError, TypeError):
+                    uri = ""
+                if not uri:
+                    self._send(400, {"error": "missing worker uri"})
+                    return
+                joined = co.add_worker(uri)
+                self._send(200, {"joined": joined,
+                                 "workers": co.live_workers()})
+                return
             self._send(404, {"error": "not found"})
 
         def do_GET(self):
@@ -786,9 +999,24 @@ def _make_handler(co: Coordinator):
             if path == "/v1/query":
                 self._send(200, co.query_infos())
                 return
+            if path == "/v1/announcement":
+                detector = co.failure_detector
+                self._send(200, {"workers": [
+                    {"uri": w,
+                     "alive": (detector is None
+                               or detector.is_alive(w))}
+                    for w in list(co.workers)]})
+                return
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 q = co.tracker.get(parts[2])
                 if q is None:
+                    # restart recovery, metadata-only: no slug is
+                    # presented here, so serve the manifest without
+                    # decoding or pinning the persisted rows
+                    detail = co.recovered_query_detail(parts[2])
+                    if detail is not None:
+                        self._send(200, detail)
+                        return
                     self._send(404, {"error": "no such query"})
                     return
                 self._send(200, co.query_detail(q))
@@ -797,6 +1025,11 @@ def _make_handler(co: Coordinator):
             if len(parts) == 6 and parts[:3] == ["v1", "statement",
                                                  "executing"]:
                 q = co.tracker.get(parts[3])
+                if q is None:
+                    # a restarted coordinator serving a query the OLD
+                    # process ran: rebuild it from the spooled manifest
+                    # (slug-checked) and keep paging
+                    q = co.recover_query(parts[3], parts[4])
                 if q is None or q.slug != parts[4]:
                     self._send(404, {"error": "no such query"})
                     return
@@ -808,9 +1041,34 @@ def _make_handler(co: Coordinator):
         def do_DELETE(self):
             if not self._authenticate():
                 return
-            parts = [p for p in urlparse(self.path).path.split("/") if p]
+            parsed = urlparse(self.path)
+            parts = [p for p in parsed.path.split("/") if p]
+            if parsed.path == "/v1/announcement":
+                from urllib.parse import parse_qs
+                uri = (parse_qs(parsed.query).get("uri") or [""])[0]
+                left = co.remove_worker(uri) if uri else False
+                self._send(200, {"left": left,
+                                 "workers": co.live_workers()})
+                return
             if len(parts) >= 4 and parts[:2] == ["v1", "statement"]:
                 co.tracker.cancel(parts[3])
+                if co.results is not None:
+                    # the client is done with this query: reap its
+                    # spooled restart-recovery results now instead of
+                    # waiting out the TTL sweep. The slug is the
+                    # per-query capability token — destroying durable
+                    # results demands it just like reading them does
+                    # (recover_query), or any client that can list
+                    # query ids could revoke another client's restart
+                    # recoverability.
+                    slug = parts[4] if len(parts) >= 5 else None
+                    q = co.tracker.get(parts[3])
+                    owner = q.slug if q is not None else None
+                    if owner is None:
+                        mf = co.results.load_manifest(parts[3])
+                        owner = str(mf.get("slug")) if mf else None
+                    if slug is not None and slug == owner:
+                        co.results.release(parts[3])
                 # 204 carries no body (RFC 7230; a body would desync
                 # keep-alive clients)
                 self.send_response(204)

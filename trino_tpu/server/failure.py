@@ -122,6 +122,21 @@ class HeartbeatFailureDetector:
             self._stats.setdefault(uri, _Stats()).record(True)
 
     def start(self) -> "HeartbeatFailureDetector":
+        """Start the active probe loop. Idempotent AND thread-safe:
+        live-membership joins call this from HTTP handler threads on
+        every announcement (the detector may have been created before
+        any worker existed) while main.py may call it from the main
+        thread — without the lock the check-then-act could spawn two
+        probe loops, doubling every node's probe weight with no way
+        to stop the orphan."""
+        with self._lock:
+            return self._start_locked()
+
+    def _start_locked(self) -> "HeartbeatFailureDetector":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+
         def loop():
             while not self._stop.wait(self.interval_s):
                 self.probe_once()

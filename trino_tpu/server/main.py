@@ -74,6 +74,30 @@ def main(argv=None) -> int:
                     help="comma-separated worker base URIs to dispatch "
                          "leaf fragments to (exec/remote.py); also "
                          "settable as worker.uris in config.properties")
+    ap.add_argument("--role", choices=("coordinator", "worker"),
+                    default=None,
+                    help="worker starts a task server instead of a "
+                         "coordinator (node.role in config.properties; "
+                         "the reference's coordinator=true|false). "
+                         "Default: coordinator")
+    ap.add_argument("--coordinator-uri", default=None,
+                    help="[worker role] coordinator to announce this "
+                         "worker to (/v1/announcement; re-announced on "
+                         "a cadence so a restarted coordinator re-"
+                         "learns the fleet). Also discovery.uri in "
+                         "config.properties")
+    ap.add_argument("--coordinator-token", default=None,
+                    help="[worker role] Bearer token sent with every "
+                         "announcement — required when the coordinator "
+                         "authenticates requests. Also discovery.token "
+                         "in config.properties / env "
+                         "TRINO_TPU_COORDINATOR_TOKEN")
+    ap.add_argument("--spool-backend", default=None,
+                    help="fault-tolerance spool backend: 'local' "
+                         "(directory tree) or 'memory' (object-store "
+                         "code path, in-process emulation); also "
+                         "spool.backend in config.properties / env "
+                         "TRINO_TPU_SPOOL_BACKEND")
     args = ap.parse_args(argv)
 
     props: Dict[str, str] = {}
@@ -86,6 +110,12 @@ def main(argv=None) -> int:
     plugins = [m for m in props.get("plugin.load", "").split(",") if m]
     port = args.port if args.port is not None else \
         int(props.get("http-server.http.port", "8080"))
+
+    # explicit CLI flag beats config.properties (same precedence as
+    # --port/--workers); only an omitted flag falls through to props
+    role = args.role or props.get("node.role", "coordinator")
+    if role == "worker":
+        return _worker_main(args, props, port)
 
     from .coordinator import Coordinator
     resource_groups = None
@@ -107,12 +137,16 @@ def main(argv=None) -> int:
                (args.workers or props.get("worker.uris", "")).split(",")
                if w.strip()]
 
+    spool_backend = (args.spool_backend
+                     or props.get("spool.backend") or None)
+
     co = Coordinator(port=port,
                      distributed=args.distributed,
                      catalogs=build_catalogs(args.etc_dir, plugins),
                      resource_groups=resource_groups,
                      authenticator=authenticator,
-                     worker_uris=workers).start()
+                     worker_uris=workers,
+                     spool_backend=spool_backend).start()
     if workers and co.failure_detector is not None:
         # a configured fleet gets the active heartbeat loop on top of
         # the scheduler's task-failure feedback
@@ -125,6 +159,51 @@ def main(argv=None) -> int:
     def on_signal(sig, frame):
         print("draining...", file=sys.stderr)
         co.drain(timeout=30.0)
+        stop["flag"] = True
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    import time
+    while not stop["flag"]:
+        time.sleep(0.2)
+    return 0
+
+
+def _worker_main(args, props: Dict[str, str], port: int) -> int:
+    """Worker role: a TaskWorkerServer that joins a coordinator's
+    worker set at runtime (/v1/announcement) — the elastic half of the
+    cluster. Start any number of these against one coordinator; each
+    announces itself now and on a cadence, so a RESTARTED coordinator
+    re-learns the fleet at the next beat, and stop() sends the
+    graceful leave."""
+    from .task_worker import TaskWorkerServer
+    spool_backend = (args.spool_backend
+                     or props.get("spool.backend") or None)
+    plugins = [m for m in props.get("plugin.load", "").split(",") if m]
+    srv = TaskWorkerServer(
+        port=port, spool_backend=spool_backend,
+        # the worker resolves the same etc/catalog configs the
+        # coordinator dispatches fragments against — without this a
+        # fragment naming an operator-configured catalog fails on
+        # every attempt
+        catalogs=build_catalogs(args.etc_dir, plugins)).start()
+    coordinator_uri = (args.coordinator_uri
+                       or props.get("discovery.uri") or None)
+    token = (args.coordinator_token or props.get("discovery.token")
+             or os.environ.get("TRINO_TPU_COORDINATOR_TOKEN") or None)
+    if coordinator_uri:
+        joined = srv.announce(coordinator_uri, token=token)
+        print(f"trino-tpu worker {srv.node_id} on {srv.base_uri} "
+              f"({'joined' if joined else 'announcing to'} "
+              f"{coordinator_uri})")
+    else:
+        print(f"trino-tpu worker {srv.node_id} on {srv.base_uri} "
+              "(standalone: pass --coordinator-uri to join a cluster)")
+
+    stop = {"flag": False}
+
+    def on_signal(sig, frame):
+        srv.stop()               # graceful leave + server shutdown
         stop["flag"] = True
 
     signal.signal(signal.SIGINT, on_signal)

@@ -84,14 +84,24 @@ class _Task:
     """One task's lifecycle + output buffer (execution/SqlTask.java +
     the ClientBuffer token protocol)."""
 
-    def __init__(self, task_id: str, attempt: int = 0, spool=None):
+    def __init__(self, task_id: str, attempt: int = 0, spool=None,
+                 catalogs=None):
         self.task_id = task_id
+        # the worker's shared CatalogManager (etc/catalog configs —
+        # None falls back to the runner's built-in defaults): a
+        # fragment naming an operator-configured catalog must resolve
+        # it here exactly as it would on the coordinator
+        self.catalogs = catalogs
         # fault-tolerant execution: which attempt of its (fragment,
         # part) this task is (exec/remote.py re-dispatches failed
         # tasks with fresh attempt ids), and the spool its completed
         # output is committed to so it survives task eviction
         self.attempt = attempt
         self.spool = spool
+        # committed-attempt directory, cached at commit time: the
+        # X-TT-Spool-Dir header is constant once the task commits, so
+        # page GETs must not re-read the COMMITTED marker per request
+        self.spool_dir: Optional[str] = None
         self.state = "RUNNING"
         self.error: Optional[str] = None
         self.pages: List[bytes] = []
@@ -120,7 +130,8 @@ class _Task:
                 from ..exec.executor import Executor
                 from ..obs.trace import QueryTrace
                 from ..plan.serde import from_jsonable
-                runner = LocalQueryRunner(session=session)
+                runner = LocalQueryRunner(session=session,
+                                          catalogs=self.catalogs)
                 plan = from_jsonable(payload["fragment"])
                 trace = QueryTrace(self.task_id) if collect else None
                 session.trace = trace
@@ -139,7 +150,8 @@ class _Task:
                 self.peak_memory_bytes = ex.peak_reserved_bytes
                 self.spill_bytes = ex.spilled_bytes
             else:
-                runner = LocalQueryRunner(session=session)
+                runner = LocalQueryRunner(session=session,
+                                          catalogs=self.catalogs)
                 res = runner.execute_batch(payload["sql"])
             codec = None
             if not bool(session.get("exchange_compression")):
@@ -154,6 +166,9 @@ class _Task:
                 try:
                     self.spool.commit(self.task_id, 0, 0,
                                       self.attempt, self.pages)
+                    getdir = getattr(self.spool, "attempt_dir", None)
+                    if getdir is not None:
+                        self.spool_dir = getdir(self.task_id, 0, 0)
                 except Exception:    # noqa: BLE001 — spool best-effort
                     pass
             self.state = "FINISHED"
@@ -169,19 +184,28 @@ class TaskWorkerServer:
     """A worker node: accepts tasks, executes them, serves result pages.
     One process per worker (the reference's worker JVM)."""
 
-    def __init__(self, port: int = 0, spool_dir: Optional[str] = None):
+    def __init__(self, port: int = 0, spool_dir: Optional[str] = None,
+                 spool_backend: Optional[str] = None, catalogs=None):
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
+        # operator-configured catalogs (etc/catalog via
+        # main.build_catalogs) — None means the runner's defaults; a
+        # standalone worker must resolve the same catalog names the
+        # coordinator dispatches
+        self.catalogs = catalogs
         # worker-side spool (fte/spool.py): tasks submitted with
         # "spool": true commit their output pages here, keyed by task
         # id, and /v1/spool serves them even after the task is evicted.
-        # The base is kept SEPARATE from the coordinator's (task-id
+        # Backend per arg/config (make_spool); for the local backend
+        # the base is kept SEPARATE from the coordinator's (task-id
         # keys vs query-id keys) so neither side's TTL sweep can reap
-        # the other's live entries
+        # the other's live entries. Non-local backends skip the
+        # X-TT-Spool-Dir coalescing hint (no directory to link from).
         from ..config import CONFIG
-        from ..fte.spool import LocalDirSpool
-        self.spool = LocalDirSpool(
-            spool_dir or CONFIG.spool_dir + "-worker")
+        from ..fte.spool import make_spool
+        self.spool = make_spool(
+            spool_backend,
+            local_base_dir=spool_dir or CONFIG.spool_dir + "-worker")
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -243,6 +267,16 @@ class TaskWorkerServer:
                     self.send_header("X-TT-Complete",
                                      "true" if complete else "false")
                     self.send_header("X-TT-Next-Token", str(token + 1))
+                    if t.spool_dir:
+                        # same-host coalescing hint: where this task's
+                        # committed frames live on disk, so a consumer
+                        # sharing the filesystem can hard-link instead
+                        # of re-writing them (LocalDirSpool
+                        # .commit_linked). Meaningless (and ignored)
+                        # across hosts — the path won't exist there.
+                        # Cached on the task at commit (constant from
+                        # then on; no marker read per page GET).
+                        self.send_header("X-TT-Spool-Dir", t.spool_dir)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -272,6 +306,16 @@ class TaskWorkerServer:
                     self.send_header("X-TT-Complete",
                                      "true" if complete else "false")
                     self.send_header("X-TT-Next-Token", str(token + 1))
+                    getdir = getattr(worker.spool, "attempt_dir", None)
+                    if complete and getdir is not None:
+                        # evicted-task path has no cached _Task entry;
+                        # the consumer only needs the hint once, so pay
+                        # the marker read on the final response alone
+                        # (local backend only — object-store spools
+                        # have no directory to link from)
+                        sdir = getdir(tid, 0, 0)
+                        if sdir:
+                            self.send_header("X-TT-Spool-Dir", sdir)
                     self.send_header("Content-Length", str(len(body)))
                     self.end_headers()
                     self.wfile.write(body)
@@ -318,6 +362,16 @@ class TaskWorkerServer:
         self.port = self._httpd.server_address[1]
         self.base_uri = f"http://127.0.0.1:{self.port}"
         self._thread: Optional[threading.Thread] = None
+        # live membership (discovery/Announcer.java analog): when told
+        # a coordinator, the worker announces itself now and on a
+        # cadence — re-announcement is idempotent at the coordinator
+        # and doubles as re-registration after a coordinator restart
+        self._announce_stop = threading.Event()
+        self._announced_to: Optional[str] = None
+        self._announce_token: Optional[str] = None
+        self._announce_thread: Optional[threading.Thread] = None
+        import uuid as _uuid
+        self.node_id = f"worker-{_uuid.uuid4().hex[:8]}"
 
     # -- task manager (SqlTaskManager) --------------------------------
     def create_task(self, tid: str, payload: dict) -> _Task:
@@ -331,7 +385,8 @@ class TaskWorkerServer:
                 return t          # idempotent update (TaskResource)
             t = _Task(tid, attempt=int(payload.get("attempt") or 0),
                       spool=(self.spool if payload.get("spool")
-                             else None))
+                             else None),
+                      catalogs=self.catalogs)
             self._tasks[tid] = t
         threading.Thread(target=t.run, args=(payload,),
                          daemon=True).start()
@@ -348,6 +403,42 @@ class TaskWorkerServer:
             t.state = "CANCELED"
             t.done.set()
 
+    # -- membership ---------------------------------------------------
+    def announce(self, coordinator_uri: str,
+                 interval_s: float = 10.0,
+                 token: Optional[str] = None) -> bool:
+        """Join ``coordinator_uri``'s worker set now, then keep
+        re-announcing on a daemon thread (registration survives a
+        coordinator restart: the fresh coordinator learns this worker
+        at the next beat). ``token`` rides as a Bearer credential on
+        every announce/leave — required when the coordinator runs an
+        authenticator, whose gate sits in front of /v1/announcement
+        like every other resource. Returns whether the first announce
+        landed. Safe to call repeatedly (e.g. re-pointing the worker
+        at a new coordinator, or after stop()): each call retires the
+        previous announcer loop via its own stop event, so exactly one
+        loop ever beats."""
+        self._announce_stop.set()       # retire any previous announcer
+        stop = self._announce_stop = threading.Event()
+        self._announced_to = coordinator_uri.rstrip("/")
+        self._announce_token = token
+        ok = announce_once(self._announced_to, self.base_uri,
+                           self.node_id, token=token)
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    announce_once(self._announced_to, self.base_uri,
+                                  self.node_id,
+                                  token=self._announce_token)
+                except Exception:       # noqa: BLE001 — next beat
+                    pass
+
+        self._announce_thread = threading.Thread(target=loop,
+                                                 daemon=True)
+        self._announce_thread.start()
+        return ok
+
     # -- lifecycle ----------------------------------------------------
     def start(self):
         self._thread = threading.Thread(
@@ -356,8 +447,44 @@ class TaskWorkerServer:
         return self
 
     def stop(self):
+        self._announce_stop.set()
+        if self._announced_to:
+            try:      # graceful leave; the heartbeat detector is the
+                #       backstop for ungraceful deaths
+                req = urllib.request.Request(
+                    f"{self._announced_to}/v1/announcement"
+                    f"?uri={self.base_uri}", method="DELETE")
+                if self._announce_token:
+                    req.add_header("Authorization",
+                                   f"Bearer {self._announce_token}")
+                with urllib.request.urlopen(req, timeout=5):
+                    pass
+            except Exception:           # noqa: BLE001
+                pass
         self._httpd.shutdown()
         self._httpd.server_close()
+
+
+def announce_once(coordinator_uri: str, worker_uri: str,
+                  node_id: Optional[str] = None,
+                  token: Optional[str] = None) -> bool:
+    """One worker-join announcement (POST /v1/announcement on the
+    coordinator — the discovery-service registration analog).
+    ``token`` is the Bearer credential for authenticated
+    coordinators."""
+    payload = json.dumps({"uri": worker_uri,
+                          "nodeId": node_id or worker_uri}).encode()
+    headers = {"Content-Type": "application/json"}
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    req = urllib.request.Request(
+        f"{coordinator_uri.rstrip('/')}/v1/announcement",
+        data=payload, headers=headers, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=5) as r:
+            return r.status == 200
+    except Exception:               # noqa: BLE001
+        return False
 
 
 def worker_main(conn, platform: Optional[str] = None):
@@ -454,7 +581,8 @@ class RemoteTaskClient:
             return json.loads(r.read())
 
     def pages_raw(self, task_id: str, cancel=None,
-                  timeout_s: float = 600.0) -> List[bytes]:
+                  timeout_s: float = 600.0,
+                  meta_out: Optional[dict] = None) -> List[bytes]:
         """Pull every result page FRAME (token-acknowledged bounded
         poll) — raw serialized bytes, so callers can spool them without
         a decode/re-encode round trip. ``cancel`` (anything with
@@ -462,7 +590,10 @@ class RemoteTaskClient:
         the ExchangeClient cancel path; ``timeout_s`` bounds the total
         wait on a wedged task. A 404 mid-pull (task evicted after
         abort, worker restart) falls back to the worker's /v1/spool
-        endpoint once: committed output survives the task entry."""
+        endpoint once: committed output survives the task entry.
+        ``meta_out``, when given, receives pull side-channel data —
+        currently ``spool_dir``, the worker's committed-attempt
+        directory (X-TT-Spool-Dir) for same-host write coalescing."""
         import urllib.error
         import time as _time
         deadline = _time.monotonic() + timeout_s
@@ -496,6 +627,10 @@ class RemoteTaskClient:
                     if r.status == 202:     # still running: poll again
                         continue
                     complete = r.headers.get("X-TT-Complete") == "true"
+                    if meta_out is not None:
+                        sdir = r.headers.get("X-TT-Spool-Dir")
+                        if sdir:
+                            meta_out["spool_dir"] = sdir
                     body = r.read()
             except urllib.error.HTTPError as e:
                 if e.code == 404 and not from_spool:

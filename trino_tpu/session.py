@@ -74,6 +74,12 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     "speculation_enabled": (bool, False),
     "speculation_multiplier": (float, 2.0),
     "speculation_min_runtime_ms": (int, 200),
+    # which spool backend a query's attempts commit through when the
+    # scheduler has to create one (fte/spool.py make_spool): "" defers
+    # to the process default (CONFIG.spool_backend / env
+    # TRINO_TPU_SPOOL_BACKEND); "local" | "memory" override it
+    # (reference: exchange-manager selection in exchange.properties)
+    "spool_backend": (str, ""),
 }
 
 
