@@ -63,3 +63,42 @@ def test_point_in_where_clause(runner):
         "(9.0, 9.0)) t(x, y) WHERE ST_Distance(ST_Point(x, y), "
         "ST_Point(0.0, 0.0)) < 5.0").rows
     assert rows == [[2]]
+
+
+def test_contains_donut_polygon_hole_excluded(runner):
+    # interior rings (holes) participate in the even-odd rule: a point
+    # inside the hole of a donut polygon is NOT contained (round-5
+    # advisor nit: the parser used to drop every ring after the shell)
+    donut = ("POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), "
+             "(4 4, 6 4, 6 6, 4 6, 4 4))")
+    rows = runner.execute(
+        f"SELECT x, ST_Contains(ST_GeometryFromText('{donut}'), "
+        "ST_Point(x, y)) FROM (VALUES "
+        "(5.0, 5.0), "      # dead center of the hole -> outside
+        "(2.0, 5.0), "      # in the ring body -> inside
+        "(4.5, 4.5), "      # inside the hole near its corner -> outside
+        "(11.0, 5.0), "     # beyond the shell -> outside
+        "(6.5, 5.0)"        # between hole and shell -> inside
+        ") t(x, y) ORDER BY x").rows
+    assert [[float(x), c] for x, c in rows] == [
+        [2.0, True], [4.5, False], [5.0, False], [6.5, True],
+        [11.0, False]]
+
+
+def test_contains_multiple_holes(runner):
+    poly = ("POLYGON ((0 0, 12 0, 12 4, 0 4, 0 0), "
+            "(1 1, 3 1, 3 3, 1 3, 1 1), (8 1, 10 1, 10 3, 8 3, 8 1))")
+    rows = runner.execute(
+        f"SELECT ST_Contains(ST_GeometryFromText('{poly}'), "
+        "ST_Point(x, y)) FROM (VALUES (2.0, 2.0), (9.0, 2.0), "
+        "(5.0, 2.0)) t(x, y)").rows
+    assert rows == [[False], [False], [True]]
+
+
+def test_contains_single_ring_unchanged(runner):
+    # the common no-hole case keeps its exact pre-fix behavior
+    rows = runner.execute(
+        "SELECT ST_Contains(ST_GeometryFromText("
+        "'POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))'), ST_Point(x, x)) "
+        "FROM (VALUES 2.0, 5.0) t(x)").rows
+    assert rows == [[True], [False]]

@@ -153,3 +153,57 @@ def test_to_from_double():
     assert list(d) == [float(v) for v in vals]
     lo2, hi2 = i128.from_double(jnp.asarray(d))
     assert _back(lo2, hi2) == vals
+
+
+def test_div_round_half_up_scaled_single_rounding():
+    # the exact double-rounding boundary (round-5 advisor nit):
+    # 0.29 averaged over 2 rows into a result scale one BELOW the sum
+    # scale. Divide-then-rescale rounds twice (29/2 -> 15, 15/10 -> 2);
+    # the fused divisor rounds once: HALF_UP(29/20) = 1.
+    lo, hi = _mk([29])
+    cnt = jnp.asarray(np.array([2], np.int64))
+    qlo, qhi = i128.div128_round_half_up_scaled(lo, hi, cnt, 1)
+    assert _back(qlo, qhi) == [1]
+    # negative sums mirror away from zero
+    lo, hi = _mk([-29])
+    qlo, qhi = i128.div128_round_half_up_scaled(lo, hi, cnt, 1)
+    assert _back(qlo, qhi) == [-1]
+    # exact halves still round away from zero: 30/(2*10) = 1.5 -> 2
+    lo, hi = _mk([30, -30])
+    cnt = jnp.asarray(np.array([2, 2], np.int64))
+    qlo, qhi = i128.div128_round_half_up_scaled(lo, hi, cnt, 1)
+    assert _back(qlo, qhi) == [2, -2]
+
+
+def test_div_round_half_up_scaled_matches_bigint(rng):
+    def half_up(v, d):
+        q, r = divmod(abs(v), d)
+        q += 2 * r >= d
+        return -q if v < 0 else q
+
+    vals = _rand_vals(rng, 64, lim=10 ** 30)
+    counts = [rng.randint(1, 10 ** 6) for _ in vals]
+    for k in (0, 1, 3):
+        lo, hi = _mk(vals)
+        cnt = jnp.asarray(np.array(counts, np.int64))
+        qlo, qhi = i128.div128_round_half_up_scaled(lo, hi, cnt, k)
+        want = [half_up(v, c * 10 ** k)
+                for v, c in zip(vals, counts)]
+        assert _back(qlo, qhi) == want
+
+
+def test_avg_post_decimal_downscale_single_rounding():
+    # executor-level repro: the avg finisher with result scale below
+    # the sum scale must produce the single-rounded quotient
+    from trino_tpu.columnar import Batch, Column
+    from trino_tpu.exec.executor import _avg_post
+    from trino_tpu.types import BIGINT, DecimalType
+    sum_t = DecimalType(38, 2)          # long decimal: (lo, hi) lanes
+    res_t = DecimalType(18, 1)
+    lo, hi = _mk([29, 30, -29])
+    batch = Batch({
+        "s": Column(sum_t, lo, None, data2=hi),
+        "c": Column(BIGINT, jnp.asarray(np.array([2, 2, 2], np.int64)),
+                    None)}, 3)
+    out = _avg_post("s", "c", res_t)(batch)
+    assert [int(v) for v in np.asarray(out.data)] == [1, 2, -1]

@@ -1,0 +1,478 @@
+"""Static-analysis layer tests: PlanSanityChecker + the AST lint.
+
+Three contracts (ISSUE 7 acceptance):
+- every tier-1 query plan (TPC-H + TPC-DS corpus) passes the full
+  validator battery clean, after optimization AND as a raw logical
+  plan;
+- each seeded invariant break is caught by the RIGHT validator, with
+  the responsible optimizer pass named;
+- the lint reports zero unsuppressed findings over the real tree (this
+  IS the CI wiring: tier-1 runs this module) and flags every seeded
+  violation in its fixtures.
+"""
+
+import textwrap
+
+import pytest
+
+from trino_tpu.analysis.lint import Finding, lint_paths, lint_source, main
+from trino_tpu.analysis.sanity import (PlanSanityChecker,
+                                       PlanValidationError,
+                                       validate_plan)
+from trino_tpu.catalog import TableHandle
+from trino_tpu.obs.metrics import PLAN_VALIDATION_FAILURES
+from trino_tpu.plan.nodes import (FilterNode, JoinClause, JoinNode,
+                                  ProjectNode, TableScanNode, UnionNode,
+                                  ValuesNode)
+from trino_tpu.rex import BOOLEAN, Call, Const, InputRef
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.types import BIGINT, VARCHAR
+
+
+@pytest.fixture(scope="module")
+def runner():
+    return LocalQueryRunner()
+
+
+def _scan(sym="n0", col="nationkey", typ=BIGINT, table="nation"):
+    return TableScanNode(TableHandle("tpch", "tiny", table),
+                         {sym: col}, {sym: typ})
+
+
+# --------------------------------------------------------------------------
+# sanity checker: the clean corpus
+# --------------------------------------------------------------------------
+
+def test_tier1_tpch_plans_validate_clean(runner):
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    ck = PlanSanityChecker()
+    for name, sql in sorted(TPCH_QUERIES.items()):
+        plan = runner.plan_sql(sql)
+        ck.validate(plan, f"q{name}")
+        ck.validate_fragment(plan, f"q{name}")
+        # the per-pass debug battery also sees raw logical plans
+        ck.validate(runner.plan_sql(sql, optimized=False),
+                    f"q{name}-logical")
+
+
+def test_tier1_tpcds_plans_validate_clean():
+    from trino_tpu.benchmarks.tpcds_queries import TPCDS_QUERIES
+    r = LocalQueryRunner()
+    r.session.catalog, r.session.schema = "tpcds", "tiny"
+    ck = PlanSanityChecker()
+    for name, sql in sorted(TPCDS_QUERIES.items()):
+        plan = r.plan_sql(sql)
+        ck.validate(plan, f"q{name}")
+        ck.validate_fragment(plan, f"q{name}")
+
+
+def test_plan_validation_session_property_end_to_end(runner):
+    # per-pass validation on: real queries still execute and return
+    # the same rows (the battery must be invisible when plans are good)
+    runner.session.set("plan_validation", True)
+    try:
+        res = runner.execute(
+            "SELECT r.r_name, count(*) FROM tpch.tiny.nation n "
+            "JOIN tpch.tiny.region r ON n.n_regionkey = r.r_regionkey "
+            "GROUP BY r.r_name ORDER BY r.r_name")
+        assert len(res.rows) == 5
+    finally:
+        runner.session.reset("plan_validation")
+
+
+# --------------------------------------------------------------------------
+# sanity checker: seeded invariant breaks, each blamed on its validator
+# --------------------------------------------------------------------------
+
+def test_dangling_inputref_caught_by_dependencies_checker():
+    bad = FilterNode(_scan(), Call(
+        "=", (InputRef("no_such_symbol", BIGINT), Const(1, BIGINT)),
+        BOOLEAN))
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, "push_filters")
+    assert ei.value.validator == "ValidateDependenciesChecker"
+    assert ei.value.pass_name == "push_filters"
+    assert "no_such_symbol" in str(ei.value)
+    assert "push_filters" in str(ei.value)
+
+
+def test_duplicate_node_object_caught():
+    scan = _scan()
+    bad = UnionNode((scan, scan), {"n0": BIGINT},
+                    ({"n0": "n0"}, {"n0": "n0"}))
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, "cleanup_projects")
+    assert ei.value.validator == "NoDuplicatePlanNodeIds"
+
+
+def test_type_mismatched_join_clause_caught():
+    left = _scan("n0", "nationkey", BIGINT, "nation")
+    right = _scan("r0", "name", VARCHAR, "region")
+    bad = JoinNode(left, right, "inner", (JoinClause("n0", "r0"),))
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, "reorder_joins")
+    assert ei.value.validator == "JoinCriteriaChecker"
+    assert "bigint" in str(ei.value) and "varchar" in str(ei.value)
+
+
+def test_join_clause_wrong_side_caught():
+    left = _scan("n0", "nationkey", BIGINT, "nation")
+    right = _scan("r0", "regionkey", BIGINT, "region")
+    bad = JoinNode(left, right, "inner", (JoinClause("r0", "r0"),))
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad)
+    assert ei.value.validator == "JoinCriteriaChecker"
+    assert "left source" in str(ei.value)
+
+
+def test_inputref_type_drift_caught_by_type_validator():
+    bad = ProjectNode(_scan(), {"p0": InputRef("n0", VARCHAR)})
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, "prune_columns")
+    assert ei.value.validator == "TypeValidator"
+
+
+def test_serde_unstable_fragment_caught():
+    # an int-keyed dict survives encode->decode only as a str-keyed
+    # dict: the fragment a retry would decode is not the fragment the
+    # first attempt ran
+    bad = ValuesNode({"v0": BIGINT}, (({1: "a"},),))
+    validate_plan(bad)          # plan battery alone is fine with it
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, "fragmenter", fragment=True)
+    assert ei.value.validator == "SerdeRoundTripChecker"
+
+
+def test_unserializable_fragment_caught():
+    bad = ValuesNode({"v0": BIGINT}, (({"a", "b"},),))   # a set
+    with pytest.raises(PlanValidationError) as ei:
+        validate_plan(bad, fragment=True)
+    assert ei.value.validator == "SerdeRoundTripChecker"
+    assert "not serializable" in str(ei.value)
+
+
+def test_validation_failures_counted():
+    before = PLAN_VALIDATION_FAILURES.value(
+        validator="ValidateDependenciesChecker")
+    bad = FilterNode(_scan(), Call(
+        "=", (InputRef("ghost", BIGINT), Const(1, BIGINT)), BOOLEAN))
+    with pytest.raises(PlanValidationError):
+        validate_plan(bad)
+    after = PLAN_VALIDATION_FAILURES.value(
+        validator="ValidateDependenciesChecker")
+    assert after == before + 1
+
+
+def test_broken_optimizer_pass_is_blamed(monkeypatch):
+    # the debug battery pins a violation on the pass that made it:
+    # corrupt prune_columns and the error must say so
+    import trino_tpu.planner.optimizer as O
+    from dataclasses import replace as dc_replace
+    real = O.prune_columns
+
+    def broken(plan):
+        out = real(plan)
+        dangling = FilterNode(out.source, Call(
+            "=", (InputRef("__broken_by_prune", BIGINT),
+                  Const(1, BIGINT)), BOOLEAN))
+        return dc_replace(out, source=dangling)
+
+    monkeypatch.setattr(O, "prune_columns", broken)
+    r = LocalQueryRunner()
+    r.session.set("plan_validation", True)
+    with pytest.raises(PlanValidationError) as ei:
+        r.execute("SELECT n_nationkey FROM tpch.tiny.nation")
+    assert ei.value.pass_name == "prune_columns"
+    assert ei.value.validator == "ValidateDependenciesChecker"
+    # without the debug property the same corruption sails through the
+    # optimizer and is caught by nothing until execution
+    r2 = LocalQueryRunner()
+    with pytest.raises(Exception) as ei2:
+        r2.execute("SELECT n_nationkey FROM tpch.tiny.nation")
+    assert not isinstance(ei2.value, PlanValidationError)
+
+
+def test_remote_dispatch_always_validates():
+    # no plan_validation property needed: a corrupt plan must die at
+    # the scheduler's door, before any worker sees a byte
+    from trino_tpu.exec.remote import RemoteScheduler
+    from trino_tpu.session import Session
+    r = LocalQueryRunner()
+    sched = RemoteScheduler(["http://127.0.0.1:1"], r.catalogs,
+                            Session(catalog="tpch", schema="tiny"))
+    bad = FilterNode(_scan(), Call(
+        "=", (InputRef("phantom", BIGINT), Const(1, BIGINT)), BOOLEAN))
+    with pytest.raises(PlanValidationError) as ei:
+        sched.execute_plan(bad)
+    assert ei.value.pass_name == "pre-dispatch"
+
+
+# --------------------------------------------------------------------------
+# lint: the real tree is clean (the CI gate)
+# --------------------------------------------------------------------------
+
+def test_lint_real_tree_zero_unsuppressed_findings():
+    from trino_tpu.analysis.lint import default_root
+    findings = [f for f in lint_paths([default_root()])
+                if not f.suppressed]
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_lint_suppressions_all_carry_reasons():
+    # a suppression without a justification is itself a finding, so
+    # the zero-unsuppressed gate above already enforces this; assert
+    # the mechanism directly too
+    from trino_tpu.analysis.lint import default_root
+    findings = lint_paths([default_root()])
+    assert not [f for f in findings
+                if f.rule == "suppression-without-reason"]
+    assert any(f.suppressed for f in findings), \
+        "expected the tree's documented suppressions to register"
+
+
+# --------------------------------------------------------------------------
+# lint: seeded race fixtures
+# --------------------------------------------------------------------------
+
+_RACE_FIXTURE = textwrap.dedent('''
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+            self.items = []
+
+        def start(self):
+            threading.Thread(target=self._run, daemon=True).start()
+
+        def _run(self):
+            self.count += 1
+            self.items.append(1)
+            with self._lock:
+                self.count += 1
+            self._helper()
+            with self._lock:
+                self._locked_helper()
+
+        def _helper(self):
+            self.count = 5
+
+        def _locked_helper(self):
+            self.count = 9
+''')
+
+
+def test_lint_flags_unguarded_thread_writes():
+    findings = lint_source(_RACE_FIXTURE, "fixture.py")
+    rules = {(f.line, f.rule) for f in findings}
+    # the two unguarded writes in the thread target
+    assert (14, "race-attr-write") in rules
+    assert (15, "race-attr-mutate") in rules
+    # the transitively reachable helper
+    assert any(r == "race-attr-write" and ln == 23
+               for ln, r in rules)
+    # guarded writes and lock-context callees are NOT findings
+    assert not any(ln in (17, 26) for ln, _ in rules), findings
+
+
+def test_lint_lock_context_propagates_through_calls():
+    src = textwrap.dedent('''
+        import threading
+
+        class Stats:
+            def record(self):
+                self.weight = 1
+
+        class Detector:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def start(self):
+                threading.Thread(target=self._loop).start()
+
+            def _loop(self):
+                with self._lock:
+                    Stats().record()
+    ''')
+    findings = [f for f in lint_source(src, "d.py")
+                if f.rule.startswith("race")]
+    assert findings == [], findings
+
+
+def test_lint_timer_target_and_obj_method_resolution():
+    src = textwrap.dedent('''
+        import threading
+
+        class Query:
+            def cancel(self):
+                self.state = "CANCELED"
+
+        def arm(q):
+            threading.Timer(5.0, q.cancel).start()
+    ''')
+    findings = lint_source(src, "t.py")
+    assert any(f.rule == "race-attr-write" and f.line == 6
+               for f in findings)
+
+
+def test_lint_positional_thread_target_resolved():
+    # Thread's FIRST positional parameter is 'group' — the callable is
+    # at index 1 in both Thread(group, target) and Timer(interval, fn)
+    src = textwrap.dedent('''
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(None, self.body).start()
+
+            def body(self):
+                self.x = 1
+    ''')
+    findings = lint_source(src, "p.py")
+    assert any(f.rule == "race-attr-write" and f.line == 9
+               for f in findings), findings
+
+
+def test_lint_handler_self_writes_exempt():
+    src = textwrap.dedent('''
+        from http.server import BaseHTTPRequestHandler
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.principal = None     # per-request instance: fine
+    ''')
+    findings = [f for f in lint_source(src, "h.py")
+                if f.rule.startswith("race")]
+    assert findings == [], findings
+
+
+# --------------------------------------------------------------------------
+# lint: seeded jit-purity fixtures
+# --------------------------------------------------------------------------
+
+_JIT_FIXTURE = textwrap.dedent('''
+    import time
+    import jax
+    import numpy as np
+
+    acc = []
+
+    def make():
+        def run(b):
+            t0 = time.perf_counter()
+            acc.append(b)
+            x = np.random.rand(3)
+            k = jax.random.PRNGKey(0)
+            local = []
+            local.append(x)
+            return b
+        return jax.jit(run)
+
+    @jax.jit
+    def decorated(x):
+        print(x)
+        return x
+''')
+
+
+def test_lint_flags_jit_impurities():
+    findings = lint_source(_JIT_FIXTURE, "jit.py")
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.line)
+    assert sorted(by_rule.get("jit-impure", [])) == [10, 12, 21]
+    assert by_rule.get("jit-closure-mutate") == [11]
+    # jax.random is pure; appends to LOCAL lists are trace-time
+    # plumbing, not closure mutation
+    assert not any(f.line in (13, 15) for f in findings)
+    assert all(f.severity == "error" for f in findings
+               if f.rule == "jit-impure")
+    assert all(f.severity == "warning" for f in findings
+               if f.rule == "jit-closure-mutate")
+
+
+def test_lint_shard_map_and_partial_decorator():
+    src = textwrap.dedent('''
+        import time
+        from functools import partial
+        import jax
+        from jax import shard_map
+
+        def build(mesh):
+            def f(x):
+                time.sleep(1)
+                return x
+            return shard_map(f, mesh=mesh, in_specs=None,
+                             out_specs=None)
+
+        @partial(jax.jit, static_argnames=("k",))
+        def kernel(x, k):
+            import random
+            return x + random.random()
+    ''')
+    findings = [f for f in lint_source(src, "s.py")
+                if f.rule == "jit-impure"]
+    assert {f.line for f in findings} == {9, 17}
+
+
+# --------------------------------------------------------------------------
+# lint: suppressions + CLI severity gate
+# --------------------------------------------------------------------------
+
+def test_lint_suppression_and_reason_requirement():
+    src = textwrap.dedent('''
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(target=self.body).start()
+
+            def body(self):
+                self.x = 1  # tt-lint: ignore[race-attr-write] single writer before publication
+                self.y = 2  # tt-lint: ignore[race-attr-write]
+                self.z = 3
+    ''')
+    findings = lint_source(src, "w.py")
+    xs = [f for f in findings if f.line == 9]
+    assert xs and all(f.suppressed for f in xs)
+    ys = [f for f in findings if f.line == 10]
+    assert any(f.suppressed for f in ys)
+    assert any(f.rule == "suppression-without-reason" and
+               not f.suppressed for f in ys)
+    zs = [f for f in findings if f.line == 11]
+    assert zs and not any(f.suppressed for f in zs)
+
+
+def test_lint_cli_fail_on_flag(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent('''
+        import threading
+
+        class W:
+            def go(self):
+                threading.Thread(target=self.body).start()
+
+            def body(self):
+                self.x = 1
+    '''))
+    assert main([str(bad)]) == 1                        # error present
+    assert main([str(bad), "--fail-on", "none"]) == 0
+    warn_only = tmp_path / "warn.py"
+    warn_only.write_text(textwrap.dedent('''
+        import jax
+
+        acc = []
+
+        def f(x):
+            acc.append(x)
+            return x
+
+        g = jax.jit(f)
+    '''))
+    assert main([str(warn_only)]) == 0                  # warnings pass
+    assert main([str(warn_only), "--fail-on", "warning"]) == 1
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert main([str(clean), "--fail-on", "warning"]) == 0
+    capsys.readouterr()

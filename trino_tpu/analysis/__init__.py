@@ -1,0 +1,19 @@
+"""Static-analysis layer: plan sanity checking + source lint.
+
+Reference parity: sql/planner/sanity/PlanSanityChecker.java — the
+reference runs every optimized plan through a validator battery
+(TypeValidator, ValidateDependenciesChecker, NoDuplicatePlanNodeIds,
+...) so a broken optimizer rule fails loudly at plan time instead of
+as a silent wrong answer. Here that battery lives in ``sanity.py``
+(wired into ``planner/optimizer.py`` per-pass under the
+``plan_validation`` session property, and always into the remote
+fragmenter), and ``lint.py`` adds a source-level AST lint for the two
+failure classes a tensor-compiled threaded engine grows on its own:
+unsynchronized shared-state writes in the threaded runtime and Python
+side effects inside jit-traced functions.
+"""
+
+from .sanity import (PlanSanityChecker, PlanValidationError,
+                     validate_plan)
+
+__all__ = ["PlanSanityChecker", "PlanValidationError", "validate_plan"]

@@ -2005,19 +2005,24 @@ def _avg_post(ssym, csym, rtype):
         cnt = jnp.asarray(c.data).astype(jnp.float64)
         valid = cnt > 0
         if isinstance(rtype, DecimalType) and s.data2 is not None:
-            # Int128 sum: exact division by the count (HALF_UP), then
-            # rescale sum-scale -> result-scale.
-            # Reference: DecimalAverageAggregation.java
+            # Int128 sum: rescale sum-scale -> result-scale, then one
+            # exact HALF_UP division by the count. A result scale
+            # BELOW the sum scale folds the 10^k into the divisor so
+            # the value rounds ONCE (divide-then-rescale rounded
+            # twice, off by one ulp at .x45 boundaries — round-5
+            # advisor nit). Reference: DecimalAverageAggregation.java
             from ..ops import int128 as i128
             lo = jnp.asarray(s.data).astype(jnp.int64)
             hi = jnp.asarray(s.data2).astype(jnp.int64)
             shift = rtype.scale - s.type.scale
             lo, hi = i128.rescale(lo, hi, max(shift, 0))
             cn = jnp.maximum(jnp.asarray(c.data).astype(jnp.int64), 1)
-            lo, hi = i128.div128_round_half_up_pair(
-                lo, hi, cn, jnp.zeros_like(cn))
             if shift < 0:
-                lo, hi = i128.rescale(lo, hi, shift)
+                lo, hi = i128.div128_round_half_up_scaled(
+                    lo, hi, cn, -shift)
+            else:
+                lo, hi = i128.div128_round_half_up_pair(
+                    lo, hi, cn, jnp.zeros_like(cn))
             if rtype.is_short:
                 return Column(rtype, lo, valid)
             return Column(rtype, lo, valid, data2=hi)

@@ -133,6 +133,12 @@ class RemoteScheduler:
         self.spool = spool
         self.excluded: set = set()
         self._excl_lock = threading.Lock()
+        # attempt counters are written by dispatch threads + the
+        # speculation monitor concurrently; += is read-modify-write, so
+        # they share a dedicated lock (found by analysis/lint.py's
+        # race-attr-write rule — lost increments would undercount
+        # retries in EXPLAIN ANALYZE and the bench fault leg)
+        self._stats_lock = threading.Lock()
         self.task_retries = 0
         self.combine_retries = 0
         self.speculative_launches = 0
@@ -305,12 +311,28 @@ class RemoteScheduler:
 
     # -- dispatch ------------------------------------------------------
     def execute_plan(self, plan: PlanNode) -> Batch:
+        from ..analysis.sanity import PlanSanityChecker
         from ..obs.trace import null_span
         trace = getattr(self.session, "trace", None)
         sp = trace.span if trace is not None else null_span
+        # ALWAYS validated before fragmentation (not only in the
+        # plan_validation debug mode): a malformed plan crossing the
+        # dispatch boundary costs a fleet-wide fan-out plus 30-90s of
+        # XLA compile per worker before it fails — the checker costs a
+        # plan walk. Fragments additionally prove serde round-trip
+        # stability, because their wire form IS what workers execute.
+        checker = PlanSanityChecker()
         frags: List[_Fragment] = []
+        payloads: Dict[int, dict] = {}
         with sp("schedule"):
+            checker.validate(plan, "pre-dispatch")
             rewritten = self._cut(plan, frags)
+            for f in frags:
+                # the round-trip-proven encoding IS the wire payload:
+                # ship the exact bytes that were validated instead of
+                # encoding the fragment a second time
+                payloads[f.fid] = checker.validate_fragment(
+                    f.plan, "fragmenter")
         if not frags:
             ex = Executor(self.catalogs, self.session,
                           self.collect_stats)
@@ -319,7 +341,7 @@ class RemoteScheduler:
             self.peak_memory_bytes = ex.peak_reserved_bytes
             self.spill_bytes = ex.spilled_bytes
             return out
-        gathered = self._run_fragments(frags)
+        gathered = self._run_fragments(frags, payloads)
         final = _substitute(rewritten, {
             f.fid: f.final_builder(_Pre(gathered[f.fid]))
             for f in frags})
@@ -388,7 +410,9 @@ class RemoteScheduler:
                                           "combine"))
         raise AssertionError("unreachable")  # loop returns or raises
 
-    def _run_fragments(self, frags: List[_Fragment]) -> Dict[int, Batch]:
+    def _run_fragments(self, frags: List[_Fragment],
+                       payloads: Optional[Dict[int, dict]] = None
+                       ) -> Dict[int, Batch]:
         """Attempt-aware dispatch: every (fragment, part) task runs a
         retry loop (fte/retry.py budgets + backoff, replacement worker
         per attempt), completed attempts commit their page frames to
@@ -435,7 +459,8 @@ class RemoteScheduler:
         trace_parent = trace.current() if trace is not None else None
         events = getattr(session, "events", None)
 
-        payloads = {f.fid: to_jsonable(f.plan) for f in frags}
+        if payloads is None:
+            payloads = {f.fid: to_jsonable(f.plan) for f in frags}
         tasks = [_TaskRun(f, part)
                  for f in frags for part in range(nparts)]
 
@@ -570,7 +595,8 @@ class RemoteScheduler:
             # main thread's untimed wait
             try:
                 if speculative:
-                    self.speculative_wins += 1
+                    with self._stats_lock:
+                        self.speculative_wins += 1
                     SPECULATIVE_WINS.inc()
                 # telemetry is best-effort: the result pages are
                 # already committed, so a failed stats fetch (transient
@@ -653,7 +679,8 @@ class RemoteScheduler:
                             st.failed = True
                     st.done.set()
                     return
-                self.task_retries += 1
+                with self._stats_lock:
+                    self.task_retries += 1
                 TASK_RETRIES.inc()
                 if trace is not None:
                     t0, t1 = st.last_window
@@ -731,7 +758,8 @@ class RemoteScheduler:
                         # the retry loop never waits on it
                         st.spec_done.set()
                         continue
-                    self.speculative_launches += 1
+                    with self._stats_lock:
+                        self.speculative_launches += 1
                     SPECULATIVE_TASKS.inc()
                     if trace is not None:
                         trace.record(

@@ -86,12 +86,19 @@ class SpoolManager:
     def maybe_cleanup(self, now: Optional[float] = None) -> int:
         """Time-gated ``cleanup``: the full sweep scans every query
         under the base, so callers on a dispatch hot path run it at
-        most once per TTL/4 (floor 60s)."""
+        most once per TTL/4 (floor 60s). The gate's check-then-set is
+        under a lock — dispatch threads of concurrent queries all call
+        this, and an unsynchronized gate let two threads win the same
+        window and run two full-directory sweeps (the same
+        shared-state-race class analysis/lint.py flags; this one sits
+        across a module boundary, outside the lint's module-local
+        reachability, hence fixed by hand)."""
         now = time.time() if now is None else now
         gate = max(min(self.ttl_s / 4, 900.0), 60.0)
-        if now - self._last_sweep < gate:
-            return 0
-        self._last_sweep = now
+        with _SWEEP_GATE_LOCK:
+            if now - self._last_sweep < gate:
+                return 0
+            self._last_sweep = now
         return self.cleanup(now)
 
     # released-query tombstones, shared by every backend: a commit
@@ -117,6 +124,10 @@ class SpoolManager:
 
 _DEFAULTS: dict = {}
 _DEFAULT_LOCK = threading.Lock()
+# guards every spool instance's sweep gate (the gate state is
+# per-instance, but a shared lock costs nothing at once-per-TTL/4
+# frequency and spares each backend from carrying its own)
+_SWEEP_GATE_LOCK = threading.Lock()
 
 
 def make_spool(backend: Optional[str] = None,

@@ -262,6 +262,17 @@ QUERY_PEAK_MEMORY_BYTES = METRICS.gauge(
     "trino_tpu_query_peak_memory_bytes",
     "Peak reserved memory (bytes) of the most recently completed query")
 
+# plan sanity checking (analysis/sanity.py): runs are counted so a
+# fleet can alert on validation being accidentally disabled (rate
+# drops to 0 while queries keep flowing); failures carry the validator
+# name — the responsible optimizer pass is in the error message
+PLAN_VALIDATIONS = METRICS.counter(
+    "trino_tpu_plan_validations_total",
+    "Plan sanity-checker batteries executed")
+PLAN_VALIDATION_FAILURES = METRICS.counter(
+    "trino_tpu_plan_validation_failures_total",
+    "Plans rejected by the sanity checker, by validator", ("validator",))
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

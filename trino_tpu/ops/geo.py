@@ -171,20 +171,36 @@ def _fmt(v: float) -> str:
 # --------------------------------------------------------------------------
 
 _POLY_RE = re.compile(
-    r"\s*POLYGON\s*\(\s*\((?P<ring>[^)]*)\)", re.IGNORECASE)
+    r"\s*POLYGON\s*\((?P<rings>.*)\)\s*\Z", re.IGNORECASE | re.DOTALL)
+_RING_RE = re.compile(r"\(([^()]*)\)")
 
 
-def _parse_polygon(wkt: str) -> Tuple[np.ndarray, np.ndarray]:
+def _parse_polygon(wkt: str) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """ALL rings of a POLYGON — shell first, then interior rings
+    (holes). The even-odd rule over the union of every ring's edges
+    makes holes fall out for free: a point inside a hole crosses both
+    the shell and the hole boundary an odd number of times each, XORing
+    back to outside. (Dropping interior rings — the pre-fix behavior —
+    reported points inside a donut hole as contained.)"""
     m = _POLY_RE.match(wkt)
     if m is None:
         raise ValueError(f"unsupported geometry for ST_Contains: "
                          f"{wkt[:40]!r}")
-    pts = []
-    for pair in m.group("ring").split(","):
-        xy = pair.split()
-        pts.append((float(xy[0]), float(xy[1])))
-    arr = np.asarray(pts, dtype=np.float64)
-    return arr[:, 0], arr[:, 1]
+    rings: List[Tuple[np.ndarray, np.ndarray]] = []
+    for ring in _RING_RE.findall(m.group("rings")):
+        pts = []
+        for pair in ring.split(","):
+            xy = pair.split()
+            pts.append((float(xy[0]), float(xy[1])))
+        if len(pts) < 3:
+            raise ValueError(
+                f"degenerate polygon ring in: {wkt[:40]!r}")
+        arr = np.asarray(pts, dtype=np.float64)
+        rings.append((arr[:, 0], arr[:, 1]))
+    if not rings:
+        raise ValueError(f"unsupported geometry for ST_Contains: "
+                         f"{wkt[:40]!r}")
+    return rings
 
 
 def _ray_cast(px: jax.Array, py: jax.Array, xs: np.ndarray,
@@ -221,12 +237,17 @@ def st_contains(shape: Column, points: Column) -> Column:
         # reference it — a filter legitimately strands dead values in
         # the dictionary
         try:
-            xs, ys = _parse_polygon(str(wkt))
+            rings = _parse_polygon(str(wkt))
         except ValueError:
             masks.append(jnp.zeros(px.shape, bool))
             parse_ok.append(False)
             continue
-        masks.append(_ray_cast(px, py, xs, ys))
+        # even-odd across ALL rings: XOR of the per-ring verdicts is
+        # exactly the edge-union crossing parity (holes excluded)
+        mask = jnp.zeros(px.shape, dtype=bool)
+        for xs, ys in rings:
+            mask = mask ^ _ray_cast(px, py, xs, ys)
+        masks.append(mask)
         parse_ok.append(True)
     stacked = jnp.stack(masks) if masks else jnp.zeros(
         (1,) + px.shape, bool)

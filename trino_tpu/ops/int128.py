@@ -230,6 +230,24 @@ def div128_round_half_up_pair(alo, ahi, blo, bhi):
     return jnp.where(q_neg, nlo, qlo), jnp.where(q_neg, nhi, qhi)
 
 
+def div128_round_half_up_scaled(lo, hi, count, pow10: int):
+    """signed (lo, hi) / (count * 10^pow10) with ONE HALF_UP rounding.
+
+    The decimal-average down-rescale path: when the result scale sits
+    below the sum scale, dividing by the count and then rescaling down
+    rounds twice — 0.29 / 2 at scale 2 is 14.5 -> HALF_UP 15, then
+    15 / 10 -> HALF_UP 2 (0.2), while the correct single-rounded
+    answer is HALF_UP(29 / 20) = 1 (0.1). Folding the 10^k into the
+    divisor keeps the reference's single rounding
+    (DecimalAverageAggregation rescales before the one divide).
+    ``count`` lanes must be positive int64; ``count * 10^pow10`` must
+    fit 128 bits (beyond that the module's documented wrap applies)."""
+    if pow10 < 0:
+        raise ValueError("pow10 must be non-negative")
+    dlo, dhi = mul_const(count, jnp.zeros_like(count), 10 ** pow10)
+    return div128_round_half_up_pair(lo, hi, dlo, dhi)
+
+
 def divmod128_trunc(alo, ahi, blo, bhi):
     """signed 128/128 truncating division (SQL integer-division and %
     semantics: quotient toward zero, remainder keeps the sign of a)."""

@@ -27,9 +27,15 @@ from ..types import Type, parse_type
 
 def _registry() -> Dict[str, type]:
     from .. import catalog, predicate, rex
+    from ..planner import logical
     from . import nodes
+    # planner.logical contributes the plan nodes born inside the
+    # planner (SemiJoinMultiNode) — without it a plan carrying one
+    # encodes fine but cannot decode, which the sanity checker's serde
+    # round-trip validator (analysis/sanity.py) treats as a broken
+    # fragment
     reg: Dict[str, type] = {}
-    for mod in (nodes, rex, predicate, catalog):
+    for mod in (nodes, rex, predicate, catalog, logical):
         for name in dir(mod):
             cls = getattr(mod, name)
             if isinstance(cls, type) and dataclasses.is_dataclass(cls):

@@ -33,10 +33,39 @@ from ..planner.logical import SemiJoinMultiNode
 from ..rex import Call, Const, InputRef, RowExpr, TRUE
 
 
+def _pass_checker(session):
+    """The per-pass sanity checker when the session enables debug
+    validation (analysis/sanity.py; reference: the PlanSanityChecker
+    battery the IterativeOptimizer runs between rules under
+    assertions). Returns None when off — the common case pays one dict
+    lookup, no import."""
+    if session is None:
+        return None
+    try:
+        enabled = bool(session.get("plan_validation"))
+    except KeyError:        # foreign session objects without the knob
+        return None
+    if not enabled:
+        return None
+    from ..analysis.sanity import PlanSanityChecker
+    return PlanSanityChecker()
+
+
 def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
-    plan = unwrap_casts(plan)
-    plan = push_filters(plan)
-    plan = single_distinct_to_groupby(plan)
+    checker = _pass_checker(session)
+
+    def ck(p: PlanNode, pass_name: str) -> PlanNode:
+        # validated AFTER the named pass so a violation is pinned on
+        # the rewrite that introduced it, not discovered at execution
+        if checker is not None:
+            checker.validate(p, pass_name)
+        return p
+
+    plan = ck(plan, "logical-planner")
+    plan = ck(unwrap_casts(plan), "unwrap_casts")
+    plan = ck(push_filters(plan), "push_filters")
+    plan = ck(single_distinct_to_groupby(plan),
+              "single_distinct_to_groupby")
     if catalogs is not None:
         from .stats import choose_join_sides, reorder_joins
         force = "AUTOMATIC"
@@ -54,14 +83,16 @@ def optimize(plan: PlanNode, catalogs=None, session=None) -> PlanNode:
             # order and runtime-heuristic distributions
             reorder = "NONE"
         if str(reorder).upper() != "NONE":
-            plan = reorder_joins(plan, catalogs)
+            plan = ck(reorder_joins(plan, catalogs), "reorder_joins")
         if use_stats or str(force).upper() != "AUTOMATIC":
-            plan = choose_join_sides(plan, catalogs, force)
+            plan = ck(choose_join_sides(plan, catalogs, force),
+                      "choose_join_sides")
         if pushdown:
-            plan = push_into_scan(plan, catalogs)
-    plan = partial_topn_through_union(plan)
-    plan = prune_columns(plan)
-    plan = cleanup_projects(plan)
+            plan = ck(push_into_scan(plan, catalogs), "push_into_scan")
+    plan = ck(partial_topn_through_union(plan),
+              "partial_topn_through_union")
+    plan = ck(prune_columns(plan), "prune_columns")
+    plan = ck(cleanup_projects(plan), "cleanup_projects")
     return plan
 
 

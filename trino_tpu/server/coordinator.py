@@ -90,7 +90,7 @@ class _Query:
             return
         # the executor polls this event between plan nodes, so cancel
         # actually interrupts execution rather than just flipping state
-        self.session.cancel = self._cancel
+        self.session.cancel = self._cancel  # tt-lint: ignore[race-attr-write] run-thread setup; only this thread's executor reads session.cancel
         try:
             runner = runner_factory(self.session)
             result = runner.execute(self.sql)
@@ -107,7 +107,7 @@ class _Query:
                 except Exception:        # noqa: BLE001 — best-effort
                     pass
             if self._transition("FINISHED"):
-                self.result = result
+                self.result = result  # tt-lint: ignore[race-attr-write] sole writer (transition winner); readers tolerate the pre-publication None (query_results re-polls)
             elif persisted and on_discard is not None:
                 # cancel raced the persist between the state check and
                 # the transition: the query ends CANCELED, so the
@@ -121,7 +121,7 @@ class _Query:
                 return
             from ..errors import classify
             ename, ecode, etype = classify(e)
-            self.error = {
+            self.error = {  # tt-lint: ignore[race-attr-write] sole writer (FAILED-transition winner); readers see None until _done gates them
                 "message": str(e),
                 "errorCode": ecode,
                 "errorName": ename,
@@ -132,14 +132,14 @@ class _Query:
             }
         finally:
             if self.ended is None:
-                self.ended = time.time()
+                self.ended = time.time()  # tt-lint: ignore[race-attr-write] benign last-write with do_cancel's stamp; both are wall-clock end times
             self._done.set()
 
     def do_cancel(self):
         self._cancel.set()
         if self._transition("CANCELED"):
             if self.ended is None:
-                self.ended = time.time()
+                self.ended = time.time()  # tt-lint: ignore[race-attr-write] benign last-write with run's finally stamp; both are wall-clock end times
             self._done.set()
 
     def wait_done(self, timeout: float) -> bool:
@@ -451,7 +451,7 @@ class Coordinator:
         return f"http://127.0.0.1:{self.port}"
 
     def start(self):
-        self._thread = threading.Thread(
+        self._thread = threading.Thread(  # tt-lint: ignore[race-attr-write] lifecycle: start() runs once on the owning thread before the server is shared
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
         return self

@@ -133,6 +133,15 @@ class _Task:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
                 plan = from_jsonable(payload["fragment"])
+                # receiving-side sanity check: the coordinator proved
+                # serde round-trip stability before dispatch, so a
+                # violation HERE means the bytes changed in transit or
+                # the worker runs a drifted plan-IR version — fail the
+                # attempt with the validator named instead of tracing
+                # a corrupt plan into XLA (the failure is retriable on
+                # another worker like any task error)
+                from ..analysis.sanity import PlanSanityChecker
+                PlanSanityChecker().validate(plan, "worker-decode")
                 trace = QueryTrace(self.task_id) if collect else None
                 session.trace = trace
                 ex = Executor(runner.catalogs, session,
@@ -143,12 +152,12 @@ class _Task:
                     with trace.span("task_execute",
                                     task=self.task_id):
                         res = ex.execute(plan)
-                    self.spans = trace.to_dicts()
+                    self.spans = trace.to_dicts()  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes; status readers wait on done
                 else:
                     res = ex.execute(plan)
-                self.node_stats = [s.to_dict() for s in ex.stats]
-                self.peak_memory_bytes = ex.peak_reserved_bytes
-                self.spill_bytes = ex.spilled_bytes
+                self.node_stats = [s.to_dict() for s in ex.stats]  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.peak_memory_bytes = ex.peak_reserved_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.spill_bytes = ex.spilled_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             else:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
@@ -157,7 +166,7 @@ class _Task:
             if not bool(session.get("exchange_compression")):
                 from ..serde import CODEC_STORE
                 codec = CODEC_STORE
-            self.pages = paginate(res, codec=codec)
+            self.pages = paginate(res, codec=codec)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             if self.spool is not None:
                 # durable output: completed pages outlive the in-memory
                 # task entry, so an aborted/evicted task's consumer can
@@ -168,13 +177,13 @@ class _Task:
                                       self.attempt, self.pages)
                     getdir = getattr(self.spool, "attempt_dir", None)
                     if getdir is not None:
-                        self.spool_dir = getdir(self.task_id, 0, 0)
+                        self.spool_dir = getdir(self.task_id, 0, 0)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 except Exception:    # noqa: BLE001 — spool best-effort
                     pass
-            self.state = "FINISHED"
+            self.state = "FINISHED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
         except Exception as e:   # noqa: BLE001
-            self.state = "FAILED"
-            self.error = f"{type(e).__name__}: {e}"
+            self.state = "FAILED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
+            self.error = f"{type(e).__name__}: {e}"  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
         finally:
             _M_TASKS.inc(state=self.state)
             self.done.set()

@@ -74,6 +74,13 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     "speculation_enabled": (bool, False),
     "speculation_multiplier": (float, 2.0),
     "speculation_min_runtime_ms": (int, 200),
+    # ---- static analysis (trino_tpu/analysis/) -----------------------
+    # run the PlanSanityChecker after EVERY optimizer pass (debug mode:
+    # a broken rewrite is blamed on the pass that broke the invariant).
+    # The checker always runs once before remote fragment dispatch
+    # regardless of this flag. (reference: the sanity battery
+    # PlanSanityChecker runs per-pass under tests/assertions)
+    "plan_validation": (bool, False),
     # which spool backend a query's attempts commit through when the
     # scheduler has to create one (fte/spool.py make_spool): "" defers
     # to the process default (CONFIG.spool_backend / env
