@@ -285,6 +285,72 @@ def _leg_fault(iters: int) -> dict:
     }
 
 
+def _leg_mpp(iters: int) -> dict:
+    """Multi-stage MPP leg: a distributed hash-join + final-aggregation
+    query through the stage-DAG scheduler (trino_tpu/stage/) — joins
+    and the final aggregation run ON the workers over the partitioned
+    worker-to-worker exchange — at 1 vs N in-process workers. Reports
+    rows/s (lineitem rows / best wall) and the exchange bytes the
+    N-worker run moved, so worker-side execution is a tracked metric
+    next to cpu_engine_rows_per_sec."""
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    from trino_tpu.obs.metrics import METRICS
+    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.server.task_worker import TaskWorkerServer
+    from trino_tpu.session import Session
+
+    sql = ("SELECT o_orderpriority, count(*), sum(l_extendedprice) "
+           "FROM lineitem JOIN orders ON l_orderkey = o_orderkey "
+           "GROUP BY o_orderpriority ORDER BY o_orderpriority")
+    nrows = int(LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(
+            "SELECT count(*) FROM lineitem").rows[0][0])
+
+    def make_session():
+        s = Session(catalog="tpch", schema="tiny")
+        s.set("multistage_execution", True)
+        return s
+
+    def ex_bytes_written():
+        # producer side only: "read" re-counts the same frames on the
+        # consumer side, and summing both would double-report the
+        # shuffle volume
+        return METRICS.counter(
+            "trino_tpu_exchange_partition_bytes_total").value(
+                direction="written")
+
+    nruns = max(iters, 1) + 1       # warm-up + timed iterations
+
+    def best_of(uris):
+        r = DistributedHostQueryRunner(uris, session=make_session())
+        r.execute(sql)            # compile + warm
+        b = float("inf")
+        for _ in range(max(iters, 1)):
+            t0 = time.perf_counter()
+            r.execute(sql)
+            b = min(b, time.perf_counter() - t0)
+        return b
+
+    workers = [TaskWorkerServer().start() for _ in range(3)]
+    try:
+        uris = [w.base_uri for w in workers]
+        t_one = best_of(uris[:1])
+        b0 = ex_bytes_written()
+        t_all = best_of(uris)
+        # identical runs: the per-query shuffle volume is the written
+        # delta divided by how many times the query executed
+        moved = (ex_bytes_written() - b0) / nruns
+    finally:
+        for w in workers:
+            w.stop()
+    return {
+        "rows_per_sec": nrows / t_all,
+        "rows_per_sec_1_worker": nrows / t_one,
+        "speedup_vs_1_worker": t_one / t_all,
+        "exchange_bytes": moved,
+    }
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -318,13 +384,14 @@ def _run_probe_body(kind: str):
         legs = [("engine", lambda: _leg_engine("sf1", 2)),
                 ("micro", lambda: _leg_micro(0.1, 2)),
                 ("telemetry", lambda: _leg_telemetry("sf1", 2)),
-                ("fault", lambda: _leg_fault(2))]
+                ("fault", lambda: _leg_fault(2)),
+                ("mpp", lambda: _leg_mpp(2))]
     for name, fn in legs:
         try:
             if name == "telemetry":
                 print(json.dumps(
                     {"leg": name, "overhead": fn()}), flush=True)
-            elif name == "fault":
+            elif name in ("fault", "mpp"):
                 print(json.dumps(dict({"leg": name}, **fn())),
                       flush=True)
             else:
@@ -384,6 +451,13 @@ def _probe(kind: str, timeout: float):
                                 f"{d.get('device_count')}")
         elif "rows_per_sec" in d:
             vals[d.get("leg", "?")] = d["rows_per_sec"]
+            # mpp leg ride-alongs: worker-side execution artifacts
+            if "speedup_vs_1_worker" in d:
+                vals["mpp_speedup"] = d["speedup_vs_1_worker"]
+            if "exchange_bytes" in d:
+                vals["mpp_exchange_bytes"] = d["exchange_bytes"]
+            if "rows_per_sec_1_worker" in d:
+                vals["mpp_1_worker"] = d["rows_per_sec_1_worker"]
         elif "overhead" in d:
             vals[d.get("leg", "?")] = d["overhead"]
             # fault leg ride-alongs: scrape-side FTE artifacts
@@ -398,7 +472,7 @@ def _probe(kind: str, timeout: float):
     expected = ("init",) if kind == "init" else \
         ("q18",) if kind == "scale" else \
         ("engine", "micro", "telemetry") + \
-        (("fault",) if kind == "cpu" else ())
+        (("fault", "mpp") if kind == "cpu" else ())
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -522,6 +596,15 @@ def main():
             cpu_vals.get("task_retries", 0.0) or 0.0, 1),
         "query_peak_memory_bytes": round(
             cpu_vals.get("peak_memory_bytes", 0.0) or 0.0, 1),
+        # multi-stage MPP (trino_tpu/stage/): a distributed hash-join +
+        # final-aggregation query with joins/aggs executing ON workers;
+        # rows/s at 3 workers, the 1-worker ratio, and the exchange
+        # bytes the partitioned shuffle moved
+        "mpp_rows_per_sec": round(cpu_vals.get("mpp", 0.0) or 0.0, 1),
+        "mpp_speedup_vs_1_worker": round(
+            cpu_vals.get("mpp_speedup", 0.0) or 0.0, 2),
+        "mpp_exchange_bytes": round(
+            cpu_vals.get("mpp_exchange_bytes", 0.0) or 0.0, 1),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale. sf100 lineitem

@@ -22,8 +22,22 @@ def test_property_registry_breadth():
                  "enable_dynamic_filtering", "distributed_sort",
                  "query_max_memory_per_node", "hash_partition_count",
                  "exchange_compression", "query_max_run_time",
-                 "use_table_statistics", "pushdown_into_scan"):
+                 "use_table_statistics", "pushdown_into_scan",
+                 "multistage_execution", "exchange_partition_count"):
         assert name in SESSION_PROPERTIES, name
+
+
+def test_multistage_execution_gates_the_stage_fragmenter():
+    """The stage-DAG path is opt-in: default off, and the scheduler
+    consults the session property (its intermediate-fan-out behavior
+    is covered end-to-end in test_stage_mpp.py)."""
+    from trino_tpu.exec.remote import RemoteScheduler
+    sched = RemoteScheduler.__new__(RemoteScheduler)
+    sched.session = Session()
+    assert not sched._multistage_enabled()
+    sched.session.set("multistage_execution", True)
+    assert sched._multistage_enabled()
+    assert int(sched.session.get("exchange_partition_count")) == 0
 
 
 def test_unknown_property_rejected():

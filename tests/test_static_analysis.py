@@ -218,6 +218,88 @@ def test_lint_real_tree_zero_unsuppressed_findings():
     assert findings == [], "\n".join(f.render() for f in findings)
 
 
+_CROSS_CALLER = textwrap.dedent('''
+    import threading
+
+    class Dispatcher:
+        def start(self, spool):
+            self.spool = spool
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            self.spool.commit("q1")
+''')
+
+_CROSS_CALLER_LOCKED = textwrap.dedent('''
+    import threading
+
+    class Dispatcher:
+        def start(self, spool):
+            self.spool = spool
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            with self._lock:
+                self.spool.commit("q1")
+''')
+
+_CROSS_CALLEE = textwrap.dedent('''
+    class Spool:
+        def commit(self, query):
+            self.last = query        # unlocked shared write
+
+    class _Private:
+        def commit(self, query):
+            self.hidden = query      # module-internal receiver
+''')
+
+
+def _write_cross(tmp_path, caller_src):
+    caller = tmp_path / "sched.py"
+    callee = tmp_path / "spoolmod.py"
+    caller.write_text(caller_src)
+    callee.write_text(_CROSS_CALLEE)
+    return str(caller), str(callee)
+
+
+def test_lint_cross_module_follows_thread_to_callee_edges(tmp_path):
+    """The PR 7 follow-on: a scheduler thread calling spool.commit()
+    is followed INTO the spool module; the unlocked write there is
+    flagged in the spool's file — and a private (_-prefixed) class is
+    exempt from cross-module name matching (its instances never cross
+    the module boundary)."""
+    caller, callee = _write_cross(tmp_path, _CROSS_CALLER)
+    findings = lint_paths([caller, callee], cross_callees=("",))
+    hits = [f for f in findings if f.rule == "race-attr-write"]
+    assert any(f.path == callee and "self.last" in f.message
+               for f in hits), findings
+    assert not any("self.hidden" in f.message for f in hits), hits
+
+
+def test_lint_cross_module_propagates_caller_lock_context(tmp_path):
+    caller, callee = _write_cross(tmp_path, _CROSS_CALLER_LOCKED)
+    findings = lint_paths([caller, callee], cross_callees=("",))
+    assert not [f for f in findings
+                if f.rule.startswith("race")], findings
+
+
+def test_lint_cross_module_disabled_stays_module_local(tmp_path):
+    caller, callee = _write_cross(tmp_path, _CROSS_CALLER)
+    findings = lint_paths([caller, callee], cross_callees=None)
+    assert not [f for f in findings
+                if f.rule.startswith("race")], findings
+
+
+def test_lint_cross_module_allowlist_scopes_callees(tmp_path):
+    """Only modules matching the callee patterns are matchable
+    receivers — the noise-control contract."""
+    caller, callee = _write_cross(tmp_path, _CROSS_CALLER)
+    findings = lint_paths([caller, callee],
+                          cross_callees=("does-not-match-anything/",))
+    assert not [f for f in findings
+                if f.rule.startswith("race")], findings
+
+
 def test_lint_suppressions_all_carry_reasons():
     # a suppression without a justification is itself a finding, so
     # the zero-unsuppressed gate above already enforces this; assert

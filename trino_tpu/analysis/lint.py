@@ -57,12 +57,26 @@ suppression with no trailing justification is itself reported
 (``suppression-without-reason``, warning): silencing a race checker
 without saying why defeats the point.
 
-Known limits (documented, deliberate): the call graph is module-local
-(a cross-module call — e.g. the scheduler's threads calling
-``fte/spool.py`` — is not followed; fte modules are covered by their
-own lock discipline plus the plan-level serde validator), receiver
-types are matched by method NAME against classes defined in the same
-module, and jit bodies are scanned directly (no interprocedural purity
+Cross-module reachability (``lint_paths`` multi-file runs): thread
+seeds stay module-local, but a reachable ``obj.m(...)`` call is ALSO
+resolved by method name against classes of the SHARED-RUNTIME callee
+modules (``_CROSS_CALLEES``: ``fte/``, ``stage/``, ``obs/metrics.py``,
+``obs/trace.py``, ``server/failure.py``) with the caller's lock
+context propagated — so the scheduler-thread -> ``fte/spool.py``
+edges (``spool.commit``/``release`` from dispatch threads) are
+followed and a spool-side unlocked write is flagged in the spool's
+file. The callee set is deliberately an allowlist: name-based
+receiver matching across the WHOLE tree would drown the signal in
+same-name methods of thread-private classes (``session.set`` on a
+task-local Session is not ``Gauge.set`` on the process registry);
+the allowlisted modules are exactly the ones whose instances cross
+thread boundaries by design. Broaden via the ``cross_callees``
+parameter (tests pass ``("",)`` to match everything).
+
+Known limits (documented, deliberate): receiver types are matched by
+method NAME (same module first, then the callee allowlist), bare-name
+calls into other modules (imported functions) are not followed, and
+jit bodies are scanned directly (no interprocedural purity
 propagation).
 """
 
@@ -211,8 +225,45 @@ class _ModuleIndex(ast.NodeVisitor):
 # race detector
 # --------------------------------------------------------------------------
 
+# shared-runtime modules whose methods thread code in OTHER modules
+# calls by design: cross-module edges are followed into these (and only
+# these — see the module docstring for why this is an allowlist)
+_CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
+                  "server/failure.py")
+
+
+class _CrossIndex:
+    """Method-name registry over the callee-eligible modules of one
+    ``lint_paths`` run: name -> [(owning analyzer, function)]. A
+    reachable attribute call resolves here AFTER module-local
+    resolution; the walk happens in the OWNING analyzer so findings
+    land in the callee's file."""
+
+    def __init__(self) -> None:
+        self.methods: Dict[str, List[Tuple["_RaceAnalyzer",
+                                           _FuncInfo]]] = {}
+
+    def add_module(self, analyzer: "_RaceAnalyzer") -> None:
+        for name, pairs in analyzer.index.methods.items():
+            for cls, fi in pairs:
+                if cls.startswith("_"):
+                    # a private class's instances are module-internal
+                    # by convention — they do not cross module
+                    # boundaries, so a cross-module name match against
+                    # one is definitionally the wrong receiver (e.g.
+                    # the detector-lock-guarded _Stats.record vs the
+                    # public StragglerDetector.record callers mean)
+                    continue
+                self.methods.setdefault(name, []).append((analyzer, fi))
+
+    def resolve(self, method: str):
+        return self.methods.get(method, ())
+
+
 class _RaceAnalyzer:
-    """Module-local thread-reachability analysis + self-write checks."""
+    """Thread-reachability analysis + self-write checks: module-local
+    seeding and call graph, plus cross-module edges into a shared
+    ``_CrossIndex`` when one is wired (lint_paths)."""
 
     def __init__(self, tree: ast.Module, path: str):
         self.tree = tree
@@ -222,6 +273,7 @@ class _RaceAnalyzer:
         self.findings: List[Finding] = []
         # (function node, locked) states already propagated
         self._visited: Set[Tuple[int, bool]] = set()
+        self.cross: Optional[_CrossIndex] = None
 
     # -- entry discovery ----------------------------------------------
     def _thread_targets(self) -> List[Tuple[_FuncInfo, ast.Call]]:
@@ -383,6 +435,15 @@ class _RaceAnalyzer:
                         " target with no enclosing lock")
                 for callee in self._resolve_callable(node.func, fi):
                     self._walk_function(callee, locked=guarded)
+                if self.cross is not None \
+                        and isinstance(node.func, ast.Attribute):
+                    # cross-module edge: the scheduler thread calling
+                    # spool.commit(...) walks the spool's method in
+                    # the spool's analyzer, caller lock context intact
+                    for other, cfi in self.cross.resolve(
+                            node.func.attr):
+                        if other is not self:
+                            other._walk_function(cfi, locked=guarded)
             for child in ast.iter_child_nodes(node):
                 scan(child, lock_depth)
 
@@ -584,9 +645,25 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
     return _apply_suppressions(findings, src.splitlines(), path)
 
 
-def lint_paths(paths: Iterable[str]) -> List[Finding]:
+def lint_paths(paths: Iterable[str],
+               cross_callees: Optional[Sequence[str]] = _CROSS_CALLEES
+               ) -> List[Finding]:
+    """Lint many files with cross-module race reachability: every file
+    is indexed first, then thread seeds propagate — following
+    attribute calls into methods of the ``cross_callees`` modules (a
+    pattern matches by substring of the /-normalized path; None
+    disables the cross pass entirely). Findings land in the file that
+    owns the flagged write; suppressions apply per file as always."""
     findings: List[Finding] = []
+    sources: Dict[str, str] = {}
+    analyzers: Dict[str, _RaceAnalyzer] = {}
+    trees: Dict[str, ast.Module] = {}
+    seen: Set[str] = set()
+    files: List[str] = []
     for path in _expand(paths):
+        if path in seen:
+            continue
+        seen.add(path)
         try:
             with open(path, encoding="utf-8") as f:
                 src = f.read()
@@ -594,7 +671,34 @@ def lint_paths(paths: Iterable[str]) -> List[Finding]:
             findings.append(Finding(path, 0, 0, "io-error", "error",
                                     str(e)))
             continue
-        findings.extend(lint_source(src, path))
+        try:
+            tree = ast.parse(src, filename=path)
+        except SyntaxError as e:
+            findings.append(Finding(path, e.lineno or 0, e.offset or 0,
+                                    "syntax-error", "error", str(e)))
+            continue
+        files.append(path)
+        sources[path] = src
+        trees[path] = tree
+        analyzers[path] = _RaceAnalyzer(tree, path)
+    if cross_callees is not None and len(analyzers) > 1:
+        cross = _CrossIndex()
+        for path, an in analyzers.items():
+            norm = path.replace(os.sep, "/")
+            if any(pat in norm for pat in cross_callees):
+                cross.add_module(an)
+        for an in analyzers.values():
+            an.cross = cross
+    for an in analyzers.values():
+        an.analyze()
+    # collect AFTER full propagation: a caller module's analyze() may
+    # have emitted findings into a callee module's analyzer
+    for path in files:
+        per_file = list(analyzers[path].findings)
+        per_file += _JitAnalyzer(trees[path], path).analyze()
+        per_file.sort(key=lambda f: (f.line, f.col, f.rule))
+        findings.extend(_apply_suppressions(
+            per_file, sources[path].splitlines(), path))
     return findings
 
 

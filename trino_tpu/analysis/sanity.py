@@ -49,11 +49,12 @@ from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from ..plan.nodes import (AggregationNode, ExchangeNode, FilterNode,
                           GroupIdNode, JoinNode, MarkDistinctNode,
-                          OutputNode, PlanNode, ProjectNode,
-                          RemoteSourceNode, SemiJoinNode, SetOpNode,
-                          SortNode, TableDeleteNode, TableScanNode,
-                          TableWriterNode, TopNNode, UnionNode,
-                          UnnestNode, ValuesNode, WindowNode)
+                          OutputNode, PartitionedOutputNode, PlanNode,
+                          ProjectNode, RemoteSourceNode, SemiJoinNode,
+                          SetOpNode, SortNode, TableDeleteNode,
+                          TableScanNode, TableWriterNode, TopNNode,
+                          UnionNode, UnnestNode, ValuesNode,
+                          WindowNode)
 from ..rex import Call, CaseExpr, Cast, InputRef, Lambda, RowExpr
 from ..types import DecimalType, Type, is_numeric, is_string
 from ..obs.metrics import PLAN_VALIDATION_FAILURES, PLAN_VALIDATIONS
@@ -355,6 +356,12 @@ class ValidateDependenciesChecker:
         elif isinstance(node, ExchangeNode):
             self._require(node, node.partition_keys, env,
                           "partition keys")
+        elif isinstance(node, PartitionedOutputNode):
+            # partitioning-key closure, producer half: a key the body
+            # does not produce would make the bucketing kernel KeyError
+            # on every worker (or worse, partition on a stale column)
+            self._require(node, node.partition_keys, env,
+                          "partition keys")
         elif isinstance(node, TableWriterNode):
             self._require(node, node.symbols, env, "writer symbols")
 
@@ -517,6 +524,106 @@ def _deep_eq(a, b) -> bool:
         return bool(a == b)
     except Exception:       # noqa: BLE001 — array-valued fields
         return a is b
+
+
+class StageBoundaryChecker:
+    """Stage-DAG boundary validator (multi-stage MPP,
+    trino_tpu/stage/): partitioning-key closure and schema agreement
+    across every PartitionedOutput/RemoteSource pair. Unlike the
+    per-plan validators above it sees the WHOLE DAG — a single stage
+    plan is internally consistent even when its RemoteSource schema
+    silently drifted from what the producer stage actually emits, so
+    the edge itself is the thing to check:
+
+    - every RemoteSourceNode names an existing producer stage;
+    - the producer's plan is rooted in a PartitionedOutputNode whose
+      partition keys the producer body produces (key closure — the
+      per-plan dependency checker covers this half too);
+    - the consumer's RemoteSource schema matches the producer's output
+      symbol-for-symbol with agreeing types (a drift here executes,
+      then joins/aggregates garbage — the exact class of wrong-answer
+      bug a validator exists for);
+    - a hash-partitioned producer carries at least one key; a gather
+      producer carries none.
+    """
+
+    name = "StageBoundaryChecker"
+
+    def validate_dag(self, stages, root_plan: PlanNode) -> None:
+        by_sid = {st.sid: st for st in stages}
+        for st in stages:
+            po = st.plan
+            if not isinstance(po, PartitionedOutputNode):
+                raise _Violation(
+                    f"stage {st.sid} plan is rooted in "
+                    f"{_node_label(po)}, expected PartitionedOutput")
+            body_schema = _schema(po.source)
+            missing = [k for k in po.partition_keys
+                       if k not in body_schema]
+            if missing:
+                raise _Violation(
+                    f"stage {st.sid} partitions by {missing} which its "
+                    f"body does not produce "
+                    f"(available: {sorted(body_schema)[:12]}...)")
+            if po.kind == "hash" and not po.partition_keys:
+                raise _Violation(
+                    f"stage {st.sid} hash-partitions with no keys")
+            if po.kind == "gather" and po.partition_keys:
+                raise _Violation(
+                    f"stage {st.sid} gathers but carries partition "
+                    f"keys {list(po.partition_keys)}")
+        for where, plan in [(f"stage {st.sid}", st.plan)
+                            for st in stages] + [("root", root_plan)]:
+            for node in walk_plan(plan):
+                if not isinstance(node, RemoteSourceNode):
+                    continue
+                for fid in node.fragment_ids:
+                    producer = by_sid.get(fid)
+                    if producer is None:
+                        raise _Violation(
+                            f"{where}: RemoteSource names unknown "
+                            f"stage {fid}")
+                    pschema = _schema(producer.plan)
+                    for sym, t in node.schema.items():
+                        pt = pschema.get(sym)
+                        if pt is None:
+                            raise _Violation(
+                                f"{where}: RemoteSource expects symbol "
+                                f"'{sym}' which stage {fid} does not "
+                                f"produce (produces: "
+                                f"{sorted(pschema)[:12]}...)")
+                        if not types_agree(t, pt):
+                            raise _Violation(
+                                f"{where}: RemoteSource symbol '{sym}' "
+                                f"expects {t} but stage {fid} produces "
+                                f"{pt}")
+
+
+def validate_stage_dag(dag, checker: Optional["PlanSanityChecker"]
+                       = None,
+                       pass_name: str = "stage-fragmenter"
+                       ) -> Dict[int, dict]:
+    """The stage flavor of the always-on pre-dispatch battery
+    (exec/remote.py): every stage plan runs the FRAGMENT battery (its
+    wire form is what workers execute — serde round-trip included),
+    the root plan runs the base battery, and the StageBoundaryChecker
+    proves every exchange edge. Returns the round-trip-proven encoding
+    per stage id — the exact bytes the scheduler ships."""
+    checker = checker or PlanSanityChecker()
+    payloads: Dict[int, dict] = {}
+    for st in dag.stages:
+        payloads[st.sid] = checker.validate_fragment(
+            st.plan, pass_name)
+    checker.validate(dag.root_plan, pass_name)
+    boundary = StageBoundaryChecker()
+    PLAN_VALIDATIONS.inc()
+    try:
+        boundary.validate_dag(dag.stages, dag.root_plan)
+    except _Violation as e:
+        PLAN_VALIDATION_FAILURES.inc(validator=boundary.name)
+        raise PlanValidationError(boundary.name, str(e),
+                                  pass_name) from e
+    return payloads
 
 
 # --------------------------------------------------------------------------

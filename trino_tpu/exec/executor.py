@@ -33,7 +33,8 @@ from ..ops.hashing import hash_columns, partition_of
 from ..plan.nodes import (AggregationNode, Aggregate, AssignUniqueIdNode,
                           EnforceSingleRowNode, ExchangeNode, FilterNode,
                           JoinNode, LimitNode, MarkDistinctNode, OffsetNode,
-                          OutputNode, PlanNode, ProjectNode, SampleNode,
+                          OutputNode, PartitionedOutputNode, PlanNode,
+                          ProjectNode, RemoteSourceNode, SampleNode,
                           SemiJoinNode, SetOpNode, SortNode, TableScanNode,
                           TopNNode, UnionNode, ValuesNode, WindowNode)
 from ..planner.logical import SemiJoinMultiNode
@@ -353,6 +354,12 @@ class Executor:
         # share of a fragment — server/task_worker.py fragment payloads;
         # reference: SqlStageExecution assigning splits to tasks)
         self.scan_partition: Optional[Tuple[int, int]] = None
+        # stage-DAG exchange input (trino_tpu/stage/): fid -> batches
+        # of this task's partition of upstream stage ``fid``'s output
+        # (the ExchangeOperator hook; wired by server/task_worker.py
+        # for worker stage tasks and by exec/remote.py for the
+        # coordinator's root stage)
+        self.exchange_reader = None
 
     def _detached(self) -> "Executor":
         """Lightweight clone captured by closures that outlive this
@@ -1427,6 +1434,36 @@ class Executor:
         # single-process execution: exchanges are identity (M3 replaces
         # this with all_to_all / all_gather over the device mesh)
         return self.execute(node.source)
+
+    def _exec_PartitionedOutputNode(self,
+                                    node: PartitionedOutputNode) -> Batch:
+        # the partitioning itself happens at the page boundary
+        # (server/task_worker.py cuts the result into partition frames
+        # with stage/repartition.py); executed directly — the
+        # coordinator running a stage plan locally, a test harness —
+        # the node is identity
+        return self.execute(node.source)
+
+    def _exec_RemoteSourceNode(self, node: RemoteSourceNode) -> Batch:
+        """Reads this task's partition of every upstream stage task
+        through the exchange hook (stage/exchange.py ExchangePuller).
+        A pull failure is a retriable attempt failure — the stage
+        scheduler re-dispatches the task, which re-pulls the committed
+        upstream frames off the spool."""
+        reader = self.exchange_reader
+        if reader is None:
+            raise QueryError(
+                "RemoteSourceNode executed outside a stage exchange "
+                "context (no exchange reader wired)")
+        batches: List[Batch] = []
+        for fid in node.fragment_ids:
+            batches.extend(reader(int(fid)))
+        if not batches:
+            from ..columnar import empty_batch
+            return empty_batch(node.schema)
+        out = (device_concat(batches) if len(batches) > 1
+               else batches[0])
+        return out
 
     def _exec__Pre(self, node: "_Pre") -> Batch:
         return node.batch

@@ -7,15 +7,25 @@ Reference parity: the coordinator drives worker JVMs through
   operator/ExchangeClient.java:149 (token-acknowledged page pulls),
 and SqlQueryScheduler/SqlStageExecution stitch the stages together.
 
-TPU-first shape: a leaf fragment (scan -> filter -> project, plus a
-partial aggregation / partial TopN / partial limit when the parent
-combines) is shipped as JSON (plan/serde.py) to every worker with a
-(part, nparts) split share; workers execute it on their own backend and
-serve serde page frames; the coordinator concatenates the partials,
-substitutes them into the plan as preloaded batches, and runs the
-remaining (combine) plan locally. Exchanges inside a TPU slice stay XLA
-collectives (parallel/spmd.py) — this module is the DCN leg between
-hosts.
+TPU-first shape, two dispatch modes:
+
+- **stage-DAG MPP** (``multistage_execution``; trino_tpu/stage/): the
+  plan is cut at exchange points into a DAG of stages — joins, final
+  aggregations, and windows execute ON WORKERS over a
+  hash-partitioned worker-to-worker exchange riding the FTE spool,
+  and the coordinator executes only the root stage (the reference's
+  SqlQueryScheduler -> SqlStageExecution -> PartitionedOutputOperator
+  shape). Plans the stage fragmenter declines fall back to:
+- **flat leaf fragments**: a leaf fragment (scan -> filter -> project,
+  plus a partial aggregation / partial TopN / partial limit when the
+  parent combines) is shipped as JSON (plan/serde.py) to every worker
+  with a (part, nparts) split share; workers execute it on their own
+  backend and serve serde page frames; the coordinator concatenates
+  the partials, substitutes them into the plan as preloaded batches,
+  and runs the remaining (combine) plan locally.
+
+Exchanges inside a TPU slice stay XLA collectives (parallel/spmd.py)
+— this module is the DCN leg between hosts.
 """
 
 from __future__ import annotations
@@ -33,22 +43,20 @@ from ..fte.retry import (COMBINE_RETRIES, TASK_RETRIES, RetryController,
                          RetryPolicy, backoff_delay, pick_worker)
 from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
                              StragglerDetector)
-from ..plan.nodes import (Aggregate, AggregationNode, FilterNode,
-                          LimitNode, OutputNode, PlanNode, ProjectNode,
-                          TableScanNode, TopNNode)
+from ..plan.nodes import (AggregationNode, FilterNode, LimitNode,
+                          PlanNode, ProjectNode, TableScanNode,
+                          TopNNode)
 from ..plan.serde import to_jsonable
-from ..rex import InputRef
 from ..session import Session
 from .executor import (Executor, NodeStats, QueryError, _Pre,
                        device_concat, merge_node_stats)
 
-# aggregate kinds a PARTIAL/FINAL split supports host-side, mapping to
-# the FINAL combine kind (reference: AggregationNode PARTIAL->FINAL +
-# InternalAggregationFunction combine; avg splits into sum+count)
-_COMBINE = {"sum": "sum", "count": "sum", "count_star": "sum",
-            "min": "min", "max": "max", "any_value": "any_value",
-            "bool_and": "bool_and", "bool_or": "bool_or", "every":
-            "bool_and"}
+# the PARTIAL/FINAL aggregation split lives in stage/fragmenter.py now
+# (shared by this flat fragmenter and the stage-DAG fragmenter — one
+# combine table, zero drift)
+from ..stage.fragmenter import (build_final_aggregation,
+                                split_aggregates,
+                                splittable_aggregates)
 
 
 class _Fragment:
@@ -83,20 +91,18 @@ def _chain_scan(node: PlanNode) -> TableScanNode:
 def _splittable_agg(node: AggregationNode) -> bool:
     if node.step != "SINGLE" or node.group_id_symbol is not None:
         return False
-    for a in node.aggregates.values():
-        if a.distinct:
-            return False
-        if a.kind == "avg":
-            continue
-        if a.kind not in _COMBINE:
-            return False
-    return True
+    return splittable_aggregates(node)
 
 
 class RemoteScheduler:
-    """Fragment a plan, dispatch leaf fragments to workers, stitch the
-    results back (SqlQueryScheduler, collapsed to leaf stages +
-    coordinator combine)."""
+    """Dispatch a plan over remote workers. Under
+    ``multistage_execution`` the stage fragmenter (stage/fragmenter.py)
+    cuts a multi-stage DAG and the stage scheduler
+    (stage/scheduler.py) runs joins/aggregations ON the workers with a
+    partitioned worker-to-worker exchange; otherwise — or when the
+    fragmenter declines the plan shape — the flat path ships leaf
+    fragments and combines on the coordinator (SqlQueryScheduler,
+    collapsed to leaf stages + coordinator combine)."""
 
     def __init__(self, worker_uris: List[str],
                  catalogs: CatalogManager, session: Session,
@@ -155,6 +161,10 @@ class RemoteScheduler:
         self._members_lock = threading.Lock()
         self._known_uris = {c.base_uri for c in self.workers}
         self.workers_joined = 0
+        # stage-DAG execution artifacts (multistage_execution): the cut
+        # DAG and its text rendering for EXPLAIN ANALYZE's stage section
+        self.stage_dag = None
+        self.stage_lines: List[str] = []
 
     def _sync_workers(self) -> None:
         """Append clients for workers that joined since dispatch.
@@ -251,60 +261,19 @@ class RemoteScheduler:
 
     def _cut_aggregation(self, node: AggregationNode,
                          frags: List[_Fragment]) -> PlanNode:
-        """PARTIAL on workers, FINAL combine + avg reconstruction at the
-        coordinator (PushPartialAggregationThroughExchange, host leg)."""
-        partial_aggs: Dict[str, Aggregate] = {}
-        final_aggs: Dict[str, Aggregate] = {}
-        avg_posts: Dict[str, Tuple[str, str]] = {}
-        from ..types import BIGINT
-        src_schema = node.source.output_schema()
-        for sym, a in node.aggregates.items():
-            if a.kind == "avg":
-                ssym, csym = sym + "$rsum", sym + "$rcnt"
-                from ..functions import aggregate_result_type
-                sum_t = aggregate_result_type("sum",
-                                              [src_schema[a.argument]])
-                partial_aggs[ssym] = Aggregate("sum", a.argument, sum_t,
-                                               mask=a.mask)
-                partial_aggs[csym] = Aggregate("count", a.argument,
-                                               BIGINT, mask=a.mask)
-                final_aggs[ssym] = Aggregate("sum", ssym, sum_t)
-                final_aggs[csym] = Aggregate("sum", csym, BIGINT)
-                avg_posts[sym] = (ssym, csym)
-            else:
-                kind = a.kind
-                out_t = a.type
-                partial_aggs[sym] = a
-                final_aggs[sym] = Aggregate(_COMBINE[kind], sym, out_t)
+        """PARTIAL on workers, FINAL combine + avg reconstruction at
+        the coordinator (PushPartialAggregationThroughExchange, host
+        leg). The split itself is shared with the stage-DAG fragmenter
+        (stage/fragmenter.py split_aggregates)."""
+        partial_aggs, final_aggs, avg_posts = split_aggregates(
+            node.aggregates, node.source.output_schema())
         part = AggregationNode(node.source, node.group_keys,
                                partial_aggs, step="SINGLE")
         fid = len(frags)
 
-        def build_final(pre, n=node, finals=final_aggs, posts=avg_posts):
-            out: PlanNode = AggregationNode(pre, n.group_keys, finals,
-                                            step="SINGLE")
-            if posts:
-                from ..rex import Call
-                assigns = {}
-                schema = out.output_schema()
-                from ..types import DecimalType
-                for s in n.output_schema():
-                    if s in posts:
-                        ssym, csym = posts[s]
-                        a = n.aggregates[s]
-                        num = InputRef(ssym, schema[ssym])
-                        den = InputRef(csym, schema[csym])
-                        # decimal division must hit the exact Int128
-                        # kernel (the planner's op naming —
-                        # "decimal_/" — not the float _arith path)
-                        op = ("decimal_/"
-                              if isinstance(a.type, DecimalType)
-                              else "/")
-                        assigns[s] = Call(op, (num, den), a.type)
-                    else:
-                        assigns[s] = InputRef(s, schema[s])
-                out = ProjectNode(out, assigns)
-            return out
+        def build_final(pre, n=node, finals=final_aggs,
+                        posts=avg_posts):
+            return build_final_aggregation(pre, n, finals, posts)
 
         frags.append(_Fragment(fid, part, build_final))
         return _Placeholder(fid, node.output_schema())
@@ -324,15 +293,32 @@ class RemoteScheduler:
         checker = PlanSanityChecker()
         frags: List[_Fragment] = []
         payloads: Dict[int, dict] = {}
+        dag = stage_payloads = None
         with sp("schedule"):
             checker.validate(plan, "pre-dispatch")
-            rewritten = self._cut(plan, frags)
-            for f in frags:
-                # the round-trip-proven encoding IS the wire payload:
-                # ship the exact bytes that were validated instead of
-                # encoding the fragment a second time
-                payloads[f.fid] = checker.validate_fragment(
-                    f.plan, "fragmenter")
+            if self._multistage_enabled():
+                from ..stage.fragmenter import StageFragmenter
+                dag = StageFragmenter(self.catalogs,
+                                      self.session).fragment(plan)
+            if dag is not None:
+                # always-on pre-dispatch battery, stage flavor: every
+                # stage plan runs the fragment validators (its wire
+                # form IS what workers execute) PLUS the stage-boundary
+                # checks — partitioning-key closure and schema/type
+                # agreement across every PartitionedOutput/RemoteSource
+                # pair (analysis/sanity.py StageBoundaryChecker)
+                from ..analysis.sanity import validate_stage_dag
+                stage_payloads = validate_stage_dag(dag, checker)
+            else:
+                rewritten = self._cut(plan, frags)
+                for f in frags:
+                    # the round-trip-proven encoding IS the wire
+                    # payload: ship the exact bytes that were validated
+                    # instead of encoding the fragment a second time
+                    payloads[f.fid] = checker.validate_fragment(
+                        f.plan, "fragmenter")
+        if dag is not None:
+            return self._execute_stages(dag, stage_payloads)
         if not frags:
             ex = Executor(self.catalogs, self.session,
                           self.collect_stats)
@@ -369,15 +355,92 @@ class RemoteScheduler:
             self.stats.extend(ex.stats)
         return out
 
-    def _execute_combine(self, final: PlanNode):
+    def _multistage_enabled(self) -> bool:
+        try:
+            return bool(self.session.get("multistage_execution"))
+        except KeyError:        # foreign session without the knob
+            return False
+
+    def _execute_stages(self, dag, payloads: Dict[int, dict]) -> Batch:
+        """Stage-DAG execution: every worker stage runs through the
+        topological stage scheduler (stage/scheduler.py) with the
+        partitioned exchange riding the workers' spools; the
+        coordinator then executes ONLY the root plan, pulling the
+        final gather partition from the last stage's tasks — under
+        the same combine retry loop as the flat path."""
+        from ..stage.exchange import ExchangePuller
+        from ..stage.scheduler import StageExecution
+        self.stage_dag = dag
+        self.stage_lines = dag.lines()
+        sx = StageExecution(self, dag, payloads)
+        sources = sx.run()
+        timeout_s = float(self.session.get("remote_task_timeout"))
+        # spool-first root gather: on a shared local spool base the
+        # coordinator reads the final stage's committed partitions
+        # directly off the workers' spool dir — a worker dying AFTER
+        # its last task committed costs nothing (the HTTP pull from
+        # the winner URI stays as the cross-host fallback)
+        root_spool = None
+        try:
+            from ..config import CONFIG
+            from ..fte.spool import make_spool, worker_spool_base
+            if (CONFIG.spool_backend or "local").lower() in (
+                    "local", "filesystem", ""):
+                root_spool = make_spool(
+                    "local", local_base_dir=worker_spool_base())
+        except Exception:       # noqa: BLE001 — HTTP path remains
+            root_spool = None
+
+        def setup(ex):
+            ex.exchange_reader = ExchangePuller(
+                sources, part=0, spool=root_spool,
+                timeout_s=timeout_s,
+                cancel=getattr(self.session, "cancel",
+                               None)).read_fragment
+
+        out, ex = self._execute_combine(dag.root_plan, setup=setup)
+        self.peak_memory_bytes = max(self.peak_memory_bytes,
+                                     ex.peak_reserved_bytes)
+        self.spill_bytes += ex.spilled_bytes
+        for peak, spill in sx.resources:
+            self.peak_memory_bytes = max(self.peak_memory_bytes, peak)
+            self.spill_bytes += spill
+        if self.collect_stats:
+            # per-stage rollup, leaf-to-root, then the coordinator's
+            # root stage — EXPLAIN ANALYZE proves WHERE each operator
+            # ran (the acceptance question: joins and final
+            # aggregations tagged with worker stages, the coordinator
+            # carrying only the root stream)
+            self.stats = []
+            for sid in sorted(sx.stage_stats):
+                ntasks = sx.ntasks.get(sid, 0)
+                nrep = sx.stage_reported.get(sid, 0)
+                tag = (f"stage {sid} x{nrep} tasks"
+                       if nrep == ntasks else
+                       f"stage {sid} x{nrep}/{ntasks} tasks reported")
+                for s in sx.stage_stats[sid]:
+                    s.detail = f"{s.detail} {tag}".strip() \
+                        if s.detail else tag
+                    self.stats.append(s)
+            for s in ex.stats:
+                s.detail = (f"{s.detail} stage root (coordinator)"
+                            .strip() if s.detail
+                            else "stage root (coordinator)")
+            self.stats.extend(ex.stats)
+        return out
+
+    def _execute_combine(self, final: PlanNode, setup=None):
         """The root (combine) stage with its own retry loop: under
         retry_policy=TASK the combine re-executes on the coordinator
         up to the per-task attempt budget — the fragment output it
         consumes is already gathered (and, when spooled, durable), so
         re-running the root costs only coordinator compute. Until PR 6
         this was the one unretried single point of failure (ROADMAP
-        item 5). A user cancel or a deterministic ``QueryError`` is
-        never retried."""
+        item 5). ``setup`` configures each attempt's Executor (the
+        stage path wires the exchange reader for the root gather — a
+        failed pull retries with a fresh executor the same way). A
+        user cancel or a deterministic ``QueryError`` is never
+        retried."""
         import time as _time
         policy = RetryPolicy.from_session(self.session)
         attempts = (max(policy.task_retry_attempts, 1)
@@ -386,6 +449,8 @@ class RemoteScheduler:
         for attempt in range(attempts):
             ex = Executor(self.catalogs, self.session,
                           self.collect_stats)
+            if setup is not None:
+                setup(ex)
             t0 = _time.perf_counter()
             try:
                 return ex.execute(final), ex
@@ -925,9 +990,12 @@ def _replace_sources(node: PlanNode, new_sources) -> PlanNode:
 
 class DistributedHostQueryRunner:
     """DistributedQueryRunner analog: parse/plan/optimize at the
-    coordinator, leaf fragments on remote worker processes, combine
-    locally (reference: testing/trino-testing's DistributedQueryRunner
-    booting a coordinator + N workers on ephemeral ports)."""
+    coordinator, execution on remote worker processes — multi-stage
+    with a worker-to-worker partitioned exchange under
+    ``multistage_execution``, flat leaf fragments + coordinator
+    combine otherwise (reference: testing/trino-testing's
+    DistributedQueryRunner booting a coordinator + N workers on
+    ephemeral ports)."""
 
     def __init__(self, worker_uris: List[str],
                  session: Optional[Session] = None, catalogs=None,
@@ -1011,7 +1079,12 @@ class DistributedHostQueryRunner:
             QUERY_PEAK_MEMORY_BYTES.set(sched.peak_memory_bytes)
         if analyze:
             from .executor import render_analyze_lines
-            lines = render_analyze_lines(plan_tree_lines(plan),
+            plan_lines = plan_tree_lines(plan)
+            if sched.stage_lines:
+                # the stage DAG the fragmenter actually dispatched —
+                # EXPLAIN ANALYZE's proof of WHERE operators ran
+                plan_lines = plan_lines + [""] + sched.stage_lines
+            lines = render_analyze_lines(plan_lines,
                                          sched.stats, trace)
             res = QueryResult(["Query Plan"], [VARCHAR],
                               [[l] for l in lines])

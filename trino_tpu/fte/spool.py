@@ -21,6 +21,14 @@ the marker; a loser deletes its own frames and reports the winner, so
 a late duplicate is discarded rather than double-counted. TTL cleanup
 reaps whole query dirs whose mtime is older than ``ttl_s`` (crashed
 coordinators leave spools behind; the next query sweeps them).
+
+The same addressing doubles as the SHUFFLE layer for multi-stage MPP
+(trino_tpu/stage/ — Trino's spooled exchange is the same object): a
+stage task commits its hash-partitioned output under the
+attempt-independent exchange key ``<qid>.s<sid>.p<part>`` with frame
+index == partition index, consumers read single partitions through
+``read_frame``, and first-commit-wins gives per-stage task retries and
+speculation their dedup for free.
 """
 
 from __future__ import annotations
@@ -111,15 +119,22 @@ class SpoolManager:
         return str(query_id) in getattr(self, "_released", ())
 
     def _mark_released(self, query_id: str) -> None:
-        released = getattr(self, "_released", None)
-        if released is None:
-            released = self._released = set()
-        released.add(str(query_id))
-        if len(released) > 4096:
-            # bounded memory; the TTL sweep backstops anything a
-            # forgotten tombstone lets through
-            released.clear()
+        # under a lock: release() is called from coordinator request
+        # threads and dispatch threads concurrently, and the lazy
+        # check-then-set could otherwise lose a tombstone to a racing
+        # first release (the cross-module race class
+        # analysis/lint.py's scheduler-thread -> spool edges exist to
+        # catch; this one was fixed alongside teaching it those edges)
+        with _TOMBSTONE_LOCK:
+            released = getattr(self, "_released", None)
+            if released is None:
+                released = self._released = set()
             released.add(str(query_id))
+            if len(released) > 4096:
+                # bounded memory; the TTL sweep backstops anything a
+                # forgotten tombstone lets through
+                released.clear()
+                released.add(str(query_id))
 
 
 _DEFAULTS: dict = {}
@@ -128,6 +143,9 @@ _DEFAULT_LOCK = threading.Lock()
 # per-instance, but a shared lock costs nothing at once-per-TTL/4
 # frequency and spares each backend from carrying its own)
 _SWEEP_GATE_LOCK = threading.Lock()
+# guards the released-query tombstone set's lazy init + mutation
+# (release() arrives from request threads and dispatch threads)
+_TOMBSTONE_LOCK = threading.Lock()
 
 
 def make_spool(backend: Optional[str] = None,
@@ -153,6 +171,18 @@ def make_spool(backend: Optional[str] = None,
         return ObjectStoreSpool(InMemoryObjectStore(), **kwargs)
     raise ValueError(f"unknown spool backend '{backend}' "
                      "(expected 'local' or 'memory')")
+
+
+def worker_spool_base() -> str:
+    """Default base directory of a WORKER's task spool — kept separate
+    from the coordinator's query-keyed spool so neither side's TTL
+    sweep can reap the other's live entries. One definition: the
+    worker binds it (server/task_worker.py) and the coordinator's
+    spool-first root gather reads through it (exec/remote.py) — a
+    drifted copy would silently degrade every gather to the HTTP
+    fallback."""
+    from ..config import CONFIG
+    return CONFIG.spool_dir + "-worker"
 
 
 def default_spool(backend: Optional[str] = None) -> SpoolManager:

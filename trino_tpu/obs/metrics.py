@@ -273,6 +273,22 @@ PLAN_VALIDATION_FAILURES = METRICS.counter(
     "trino_tpu_plan_validation_failures_total",
     "Plans rejected by the sanity checker, by validator", ("validator",))
 
+# multi-stage MPP (trino_tpu/stage/): the partitioned worker-to-worker
+# exchange. "written" counts a producing task cutting its output into
+# partition frames; "read" counts a consuming task pulling its
+# partition of upstream tasks (stage/repartition.py, stage/exchange.py)
+# — defined here because the two directions live in different modules
+# and their identity must not drift.
+EXCHANGE_PARTITIONS = METRICS.counter(
+    "trino_tpu_exchange_partitions_total",
+    "Partitioned-exchange frames by direction", ("direction",))
+EXCHANGE_PARTITION_BYTES = METRICS.counter(
+    "trino_tpu_exchange_partition_bytes_total",
+    "Serialized partitioned-exchange bytes by direction", ("direction",))
+STAGES_SCHEDULED = METRICS.counter(
+    "trino_tpu_stages_scheduled_total",
+    "Worker stages dispatched by the stage-DAG scheduler")
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

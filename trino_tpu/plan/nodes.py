@@ -449,13 +449,43 @@ class ExchangeNode(PlanNode):
 
 @dataclass(frozen=True)
 class RemoteSourceNode(PlanNode):
-    """plan/RemoteSourceNode.java — reads a fragment's exchange output."""
+    """plan/RemoteSourceNode.java — reads a fragment's exchange output.
+
+    In the stage-DAG path (trino_tpu/stage/) ``fragment_ids`` name the
+    upstream STAGES whose partitioned output this node consumes: a task
+    executing this node pulls its own partition index from every task
+    of each named stage (exec/executor.py ``_exec_RemoteSourceNode``
+    through the stage exchange puller)."""
     fragment_ids: Tuple[int, ...]
     schema: Dict[str, Type]
     kind: str = "repartition"
 
     def output_schema(self):
         return dict(self.schema)
+
+
+@dataclass(frozen=True)
+class PartitionedOutputNode(PlanNode):
+    """The producing half of a stage boundary (reference:
+    sql/planner/plan/ExchangeNode partitioning scheme +
+    operator/output/PartitionedOutputOperator.java). A stage whose plan
+    is rooted here hash-partitions its output rows across the consumer
+    stage's tasks by ``partition_keys`` (kind="hash"); kind="gather"
+    emits a single partition for a single consumer (the root stage or a
+    1-task FINAL aggregation). The partition COUNT is not part of the
+    plan — the stage scheduler fixes it at dispatch time (the consumer
+    stage's task count), exactly like the reference's bucket-count
+    decision living in scheduling, not in the fragment."""
+    source: PlanNode
+    partition_keys: Tuple[str, ...] = ()
+    kind: str = "hash"              # hash | gather
+
+    @property
+    def sources(self):
+        return (self.source,)
+
+    def output_schema(self):
+        return self.source.output_schema()
 
 
 # --- DML ------------------------------------------------------------------
@@ -533,6 +563,10 @@ def plan_tree_lines(node: PlanNode, indent: int = 0) -> List[str]:
         detail = f"[{node.count}]"
     elif isinstance(node, ExchangeNode):
         detail = f"[{node.kind}/{node.scope} by {list(node.partition_keys)}]"
+    elif isinstance(node, PartitionedOutputNode):
+        detail = f"[{node.kind} by {list(node.partition_keys)}]"
+    elif isinstance(node, RemoteSourceNode):
+        detail = f"[stages {list(node.fragment_ids)}]"
     elif isinstance(node, OutputNode):
         detail = f"[{', '.join(node.names)}]"
     lines = [f"{pad}- {name}{detail}"]

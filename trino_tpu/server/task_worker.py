@@ -123,6 +123,7 @@ class _Task:
             # reference's TaskStatus/TaskStats carrying OperatorStats
             # to the coordinator for the stage rollup)
             collect = bool(payload.get("collect_stats"))
+            stage = None
             if "fragment" in payload:
                 # serialized PlanFragment + split share — the remote
                 # task path (reference: SqlTaskManager.java:370-403
@@ -130,6 +131,7 @@ class _Task:
                 from ..exec.executor import Executor
                 from ..obs.trace import QueryTrace
                 from ..plan.serde import from_jsonable
+                from ..plan.nodes import PartitionedOutputNode
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
                 plan = from_jsonable(payload["fragment"])
@@ -148,13 +150,30 @@ class _Task:
                               collect_stats=collect)
                 ex.scan_partition = (int(payload["part"]),
                                      int(payload["nparts"]))
+                # stage-DAG task (trino_tpu/stage/): RemoteSource
+                # leaves pull this task's partition of every upstream
+                # task through the spool / partition endpoint, and the
+                # PartitionedOutputNode root is peeled — partitioning
+                # happens below, at the page boundary
+                stage = payload.get("stage")
+                body = plan
+                if stage is not None:
+                    from ..stage.exchange import ExchangePuller
+                    puller = ExchangePuller(
+                        stage.get("sources") or {},
+                        part=int(payload["part"]), spool=self.spool,
+                        timeout_s=float(
+                            session.get("remote_task_timeout")))
+                    ex.exchange_reader = puller.read_fragment
+                    if isinstance(plan, PartitionedOutputNode):
+                        body = plan.source
                 if trace is not None:
                     with trace.span("task_execute",
                                     task=self.task_id):
-                        res = ex.execute(plan)
+                        res = ex.execute(body)
                     self.spans = trace.to_dicts()  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes; status readers wait on done
                 else:
-                    res = ex.execute(plan)
+                    res = ex.execute(body)
                 self.node_stats = [s.to_dict() for s in ex.stats]  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.peak_memory_bytes = ex.peak_reserved_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.spill_bytes = ex.spilled_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
@@ -166,20 +185,40 @@ class _Task:
             if not bool(session.get("exchange_compression")):
                 from ..serde import CODEC_STORE
                 codec = CODEC_STORE
-            self.pages = paginate(res, codec=codec)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
-            if self.spool is not None:
-                # durable output: completed pages outlive the in-memory
-                # task entry, so an aborted/evicted task's consumer can
-                # still re-read them through /v1/spool (the
-                # exchange-spooling half of fault-tolerant execution)
-                try:
-                    self.spool.commit(self.task_id, 0, 0,
-                                      self.attempt, self.pages)
-                    getdir = getattr(self.spool, "attempt_dir", None)
-                    if getdir is not None:
-                        self.spool_dir = getdir(self.task_id, 0, 0)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
-                except Exception:    # noqa: BLE001 — spool best-effort
-                    pass
+            if stage is not None:
+                # partitioned output: exactly one frame per downstream
+                # task (frame i == partition i), committed to the spool
+                # under the attempt-independent exchange key — the
+                # spool IS the shuffle medium here, so an unwritable
+                # spool must FAIL the attempt (the output would be
+                # unreachable), unlike the best-effort legacy commit
+                from ..stage.repartition import partition_frames
+                from ..plan.nodes import PartitionedOutputNode as _PO
+                keys, kind = (), "gather"
+                if isinstance(plan, _PO):
+                    keys, kind = plan.partition_keys, plan.kind
+                self.pages = partition_frames(  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                    res, keys, kind,
+                    int(stage.get("nparts_out") or 1), codec=codec)
+                self.spool.commit(str(stage["exchange_key"]), 0, 0,
+                                  self.attempt, self.pages)
+            else:
+                self.pages = paginate(res, codec=codec)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                if self.spool is not None:
+                    # durable output: completed pages outlive the
+                    # in-memory task entry, so an aborted/evicted
+                    # task's consumer can still re-read them through
+                    # /v1/spool (the exchange-spooling half of
+                    # fault-tolerant execution)
+                    try:
+                        self.spool.commit(self.task_id, 0, 0,
+                                          self.attempt, self.pages)
+                        getdir = getattr(self.spool, "attempt_dir",
+                                         None)
+                        if getdir is not None:
+                            self.spool_dir = getdir(self.task_id, 0, 0)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
             self.state = "FINISHED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
         except Exception as e:   # noqa: BLE001
             self.state = "FAILED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
@@ -210,11 +249,10 @@ class TaskWorkerServer:
         # keys vs query-id keys) so neither side's TTL sweep can reap
         # the other's live entries. Non-local backends skip the
         # X-TT-Spool-Dir coalescing hint (no directory to link from).
-        from ..config import CONFIG
-        from ..fte.spool import make_spool
+        from ..fte.spool import make_spool, worker_spool_base
         self.spool = make_spool(
             spool_backend,
-            local_base_dir=spool_dir or CONFIG.spool_dir + "-worker")
+            local_base_dir=spool_dir or worker_spool_base())
         worker = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -329,6 +367,28 @@ class TaskWorkerServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # /v1/partition/{exchange_key}/{index}: ONE partition
+                # frame of a committed stage-task attempt, straight
+                # off the spool — the serve half of the worker-to-
+                # worker exchange (consumers on a shared spool never
+                # call this; it is the cross-host leg). 404 until the
+                # attempt commits: the scheduler only advertises
+                # FINISHED tasks, so a 404 here means eviction/reap —
+                # a retriable consumer-attempt failure.
+                if len(parts) == 4 and parts[:2] == ["v1", "partition"]:
+                    key, index = parts[2], int(parts[3])
+                    frame = worker.spool.read_frame(key, 0, 0, index)
+                    if frame is None:
+                        self.send_error(404)
+                        return
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/octet-stream")
+                    self.send_header("Content-Length",
+                                     str(len(frame)))
+                    self.end_headers()
+                    self.wfile.write(frame)
+                    return
                 # /v1/task/{id} -> status (incl. the worker-side
                 # operator stats + span tree for the stage rollup)
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
@@ -393,8 +453,10 @@ class TaskWorkerServer:
             if t is not None:
                 return t          # idempotent update (TaskResource)
             t = _Task(tid, attempt=int(payload.get("attempt") or 0),
+                      # a stage task ALWAYS spools: the spool is the
+                      # exchange medium its consumers read
                       spool=(self.spool if payload.get("spool")
-                             else None),
+                             or payload.get("stage") else None),
                       catalogs=self.catalogs)
             self._tasks[tid] = t
         threading.Thread(target=t.run, args=(payload,),
@@ -562,17 +624,24 @@ class RemoteTaskClient:
                         nparts: int,
                         properties: Optional[dict] = None,
                         collect_stats: bool = False,
-                        attempt: int = 0, spool: bool = False):
+                        attempt: int = 0, spool: bool = False,
+                        stage: Optional[dict] = None):
         """POST a serialized plan fragment + split share (the
         HttpRemoteTask TaskUpdateRequest analog). ``attempt`` tags the
         task's retry/speculation generation; ``spool`` asks the worker
-        to commit completed output pages to its spool."""
-        return self._post(task_id, {
+        to commit completed output pages to its spool. ``stage``
+        carries the stage-DAG task context (trino_tpu/stage/): the
+        stage id, the attempt-independent exchange key, the output
+        partition count, and the upstream exchange sources to pull."""
+        body = {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
             "collect_stats": collect_stats,
             "attempt": attempt, "spool": spool,
-            "properties": properties or {}})
+            "properties": properties or {}}
+        if stage is not None:
+            body["stage"] = stage
+        return self._post(task_id, body)
 
     def status(self, task_id: str) -> dict:
         """GET the task status JSON, including worker-reported
@@ -580,6 +649,36 @@ class RemoteTaskClient:
         with urllib.request.urlopen(
                 f"{self.base_uri}/v1/task/{task_id}", timeout=30) as r:
             return json.loads(r.read())
+
+    def wait_done(self, task_id: str, cancel=None,
+                  timeout_s: float = 600.0,
+                  poll_s: float = 0.05) -> dict:
+        """Poll task status until a terminal state and return the final
+        status JSON (a stage task's consumers read its output off the
+        spool/partition endpoint, so completion — not pages — is what
+        the scheduler waits on). ``cancel`` (anything with ``is_set``)
+        aborts between polls; ``timeout_s`` bounds the wait on a
+        wedged worker, turning it into a retriable attempt failure."""
+        import time as _time
+        deadline = _time.monotonic() + timeout_s
+        while True:
+            if cancel is not None and cancel.is_set():
+                try:
+                    self.abort(task_id)
+                except Exception:       # noqa: BLE001
+                    pass
+                raise RuntimeError(f"task {task_id} canceled")
+            if _time.monotonic() > deadline:
+                try:
+                    self.abort(task_id)
+                except Exception:       # noqa: BLE001
+                    pass
+                raise RuntimeError(
+                    f"task {task_id} did not finish in {timeout_s}s")
+            st = self.status(task_id)
+            if st.get("state") != "RUNNING":
+                return st
+            _time.sleep(poll_s)
 
     def _post(self, task_id: str, body: dict):
         payload = json.dumps(body).encode()

@@ -87,6 +87,19 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # TRINO_TPU_SPOOL_BACKEND); "local" | "memory" override it
     # (reference: exchange-manager selection in exchange.properties)
     "spool_backend": (str, ""),
+    # ---- multi-stage MPP (trino_tpu/stage/) --------------------------
+    # route distributed queries through the stage-DAG scheduler: the
+    # plan is cut at exchange points, joins/aggregations execute ON
+    # WORKERS over a hash-partitioned worker-to-worker exchange, the
+    # coordinator streams only the root stage. Off by default while
+    # the flat leaf-fragment path remains the battle-tested default —
+    # plans the fragmenter declines fall back to it either way.
+    "multistage_execution": (bool, False),
+    # task fan-out of intermediate (exchange-fed) stages; 0 = one task
+    # per live worker (the leaf fan-out keeps following
+    # hash_partition_count — reference: SystemSessionProperties
+    # FAULT_TOLERANT_EXECUTION_PARTITION_COUNT)
+    "exchange_partition_count": (int, 0),
 }
 
 

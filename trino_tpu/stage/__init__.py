@@ -1,0 +1,18 @@
+"""Multi-stage MPP execution (the stage-DAG subsystem).
+
+Reference parity: SqlQueryScheduler -> SqlStageExecution -> RemoteTask
+with PartitionedOutputOperator hash repartition (SURVEY L5/L6). A plan
+is cut at exchange points into a DAG of stages (fragmenter.py); each
+stage runs as N worker tasks whose output is hash-partitioned across
+the downstream stage's tasks (repartition.py) and committed to the
+content-addressed FTE spool (fte/spool.py); downstream tasks PULL their
+partition of every upstream task through the spool or the producing
+worker's partition endpoint (exchange.py); the stage scheduler
+(scheduler.py) drives the DAG topologically with per-stage task retries
+and straggler speculation. The coordinator executes only the root
+stage, streaming the final gather.
+"""
+
+from .fragmenter import Stage, StageDAG, StageFragmenter  # noqa: F401
+from .repartition import partition_batch, partition_frames  # noqa: F401
+from .exchange import ExchangePuller, exchange_task_key  # noqa: F401

@@ -1,0 +1,104 @@
+"""Worker-to-worker partitioned exchange: the consuming half.
+
+Reference parity: operator/ExchangeOperator + ExchangeClient pulling a
+RemoteSourceNode's pages from upstream tasks' output buffers. Here the
+shuffle medium is the content-addressed FTE spool (fte/spool.py): a
+stage task's attempt commits exactly one frame per output partition
+under the attempt-independent exchange key ``<qid>.s<sid>.p<part>``,
+so a consumer addresses partition ``p`` of upstream task ``t`` as
+frame ``p`` of that key's COMMITTED attempt — no manifest, and task
+retries/speculation dedupe through the spool's first-commit-wins
+marker exactly like any other attempt.
+
+Pull order per upstream task:
+  1. the local spool (``read_frame``) — on a shared spool base
+     (same-host worker fleet, or the object-store backend) this is the
+     whole exchange: a consumer never touches the network, and a DEAD
+     producer's committed output is still readable (what makes
+     mid-DAG task retry recovery work);
+  2. HTTP ``GET /v1/partition/{key}/{index}`` on the worker the
+     scheduler observed winning the task (server/task_worker.py) —
+     the cross-host leg when spools are not shared.
+
+A partition that resolves nowhere raises — the consuming ATTEMPT
+fails and the stage scheduler's retry machinery takes over.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+from typing import Dict, List, Optional
+
+from ..obs.metrics import EXCHANGE_PARTITION_BYTES, EXCHANGE_PARTITIONS
+
+
+def exchange_task_key(query_id: str, sid: int, part: int) -> str:
+    """Attempt-independent spool address of one stage task's output
+    (every attempt of the task commits under this key; the COMMITTED
+    marker arbitrates)."""
+    return f"{query_id}.s{sid}.p{part}"
+
+
+class ExchangePuller:
+    """Reads this task's partition of every upstream stage task.
+
+    ``sources`` maps stage id (as str or int — JSON stringifies dict
+    keys on the wire) to ``{"tasks": [exchange keys...],
+    "uris": [winning worker base uris...]}`` as recorded by the stage
+    scheduler. ``spool`` is the caller's local spool (the worker's own,
+    or a worker-shaped spool on the coordinator) and may be None.
+    """
+
+    def __init__(self, sources: Dict, part: int, spool=None,
+                 timeout_s: float = 600.0, cancel=None):
+        self.sources = {str(k): v for k, v in (sources or {}).items()}
+        self.part = int(part)
+        self.spool = spool
+        self.timeout_s = float(timeout_s)
+        self.cancel = cancel
+
+    # -- one partition frame ------------------------------------------
+    def pull_frame(self, key: str, uri: Optional[str]) -> bytes:
+        if self.cancel is not None and self.cancel.is_set():
+            raise RuntimeError(f"exchange pull of {key} canceled")
+        errors: List[str] = []
+        if self.spool is not None:
+            try:
+                frame = self.spool.read_frame(key, 0, 0, self.part)
+            except Exception as e:      # noqa: BLE001 — fall to HTTP
+                frame, errors = None, [f"spool: {type(e).__name__}: {e}"]
+            if frame is not None:
+                return frame
+        if uri:
+            try:
+                with urllib.request.urlopen(
+                        f"{uri.rstrip('/')}/v1/partition/{key}/"
+                        f"{self.part}",
+                        timeout=max(1.0, min(self.timeout_s, 60.0))) as r:
+                    return r.read()
+            except Exception as e:      # noqa: BLE001
+                errors.append(f"{uri}: {type(e).__name__}: {e}")
+        raise RuntimeError(
+            f"exchange partition {self.part} of {key} unavailable"
+            + (f" ({'; '.join(errors)})" if errors else ""))
+
+    # -- the Executor hook (exec/executor.py _exec_RemoteSourceNode) --
+    def read_fragment(self, fid: int) -> List:
+        """Deserialized batches: this task's partition of every task of
+        upstream stage ``fid``."""
+        from ..serde import deserialize_batch
+        src = self.sources.get(str(fid))
+        if src is None:
+            raise RuntimeError(
+                f"no exchange source recorded for stage {fid}")
+        tasks = list(src.get("tasks") or ())
+        uris = list(src.get("uris") or ())
+        uris += [None] * (len(tasks) - len(uris))
+        out, nbytes = [], 0
+        for key, uri in zip(tasks, uris):
+            frame = self.pull_frame(key, uri)
+            nbytes += len(frame)
+            out.append(deserialize_batch(frame))
+        EXCHANGE_PARTITIONS.inc(len(out), direction="read")
+        EXCHANGE_PARTITION_BYTES.inc(nbytes, direction="read")
+        return out

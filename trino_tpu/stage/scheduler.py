@@ -1,0 +1,394 @@
+"""Topological stage scheduler: SqlQueryScheduler for the stage DAG.
+
+Reference parity: SqlQueryScheduler driving one SqlStageExecution per
+fragment — each stage's tasks dispatch once every input stage's output
+is committed, and the coordinator participates only as the root
+stage's consumer. Fault tolerance rides the same per-attempt machinery
+as the flat path (fte/retry.py budgets + backoff + worker rotation,
+fte/speculate.py straggler duplicates): every attempt of a stage task
+commits its partition frames to the WORKER's spool under the
+attempt-independent exchange key, so the spool's first-commit-wins
+marker arbitrates duplicate attempts per-stage for free, and a task
+retried after its worker died re-pulls its upstream partitions off the
+spool (stage/exchange.py).
+
+Scheduling is stage-by-stage with a barrier (the DAG arrives in
+topological order from the fragmenter; eager cross-stage pipelining is
+a follow-on — correctness first, the exchange layout already permits
+it since consumers address committed frames only).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional, Tuple
+
+from ..exec.executor import NodeStats
+from ..fte.retry import (TASK_RETRIES, RetryController, RetryPolicy,
+                         backoff_delay, pick_worker)
+from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
+                             StragglerDetector)
+from ..obs.metrics import STAGES_SCHEDULED
+from .exchange import exchange_task_key
+from .fragmenter import Stage, StageDAG
+
+
+class _Watch:
+    """``is_set()`` ORs several events — aborts a status poll the
+    moment a sibling attempt wins or the user cancels."""
+
+    __slots__ = ("_events",)
+
+    def __init__(self, *events):
+        self._events = [e for e in events if e is not None]
+
+    def is_set(self) -> bool:
+        return any(e.is_set() for e in self._events)
+
+
+class _STask:
+    """One (stage, partition) task's dispatch state across attempts."""
+
+    __slots__ = ("sid", "part", "key", "done", "spec_done", "lock",
+                 "failed", "errors", "winner", "_attempts",
+                 "running_since", "running_worker", "speculated")
+
+    def __init__(self, sid: int, part: int, key: str):
+        self.sid = sid
+        self.part = part
+        self.key = key
+        self.done = threading.Event()
+        self.spec_done = threading.Event()
+        self.lock = threading.Lock()
+        self.failed = False
+        self.errors: List[str] = []
+        # (attempt, worker index, speculative) of the first completion
+        self.winner: Optional[Tuple[int, int, bool]] = None
+        self._attempts = 0
+        self.running_since: Optional[float] = None
+        self.running_worker: Optional[int] = None
+        self.speculated = False
+
+    def next_attempt(self) -> int:
+        with self.lock:
+            attempt = self._attempts
+            self._attempts += 1
+            return attempt
+
+
+class StageExecution:
+    """Runs every worker stage of a DAG for one query; the caller
+    (exec/remote.py RemoteScheduler) then executes the root plan on
+    the coordinator against ``self.sources``."""
+
+    def __init__(self, scheduler, dag: StageDAG,
+                 payloads: Dict[int, dict],
+                 qid: Optional[str] = None):
+        self.s = scheduler              # the owning RemoteScheduler
+        self.dag = dag
+        self.payloads = payloads
+        self.qid = qid or uuid.uuid4().hex[:12]
+        session = scheduler.session
+        self.policy = RetryPolicy.from_session(session)
+        self.controller = RetryController(self.policy)
+        self.straggler = StragglerDetector(
+            multiplier=float(session.get("speculation_multiplier")),
+            min_runtime_s=int(
+                session.get("speculation_min_runtime_ms")) / 1000.0)
+        self.speculation_on = bool(
+            session.get("speculation_enabled")) \
+            and len(scheduler.workers) > 1
+        # sid -> {"tasks": [exchange keys], "uris": [winner uris]}
+        self.sources: Dict[int, dict] = {}
+        self.ntasks: Dict[int, int] = {}
+        self._assign_task_counts()
+        # per-stage telemetry for the EXPLAIN ANALYZE rollup
+        # (sid -> MERGED per-node stats across the stage's tasks)
+        self.stage_stats: Dict[int, List[NodeStats]] = {}
+        self.stage_reported: Dict[int, int] = {}
+        self.resources: List[Tuple[int, int]] = []   # (peak, spill)
+
+    # -- task-count assignment ----------------------------------------
+    def _assign_task_counts(self) -> None:
+        """Fix every stage's task fan-out up front (a stage's OUTPUT
+        partition count is its consumer's task count — the bucket-count
+        decision the plan deliberately does not carry). Leaf fan-out
+        follows hash_partition_count like the flat path; intermediate
+        stages follow exchange_partition_count; a stage fed by a
+        gather exchange runs exactly one task (it consumes the single
+        gathered partition)."""
+        session = self.s.session
+        nworkers = len(self.s.workers)
+        hpc = int(session.get("hash_partition_count"))
+        epc = int(session.get("exchange_partition_count"))
+        for st in self.dag.stages:
+            if not st.inputs:
+                n = min(nworkers, hpc) if hpc > 0 else nworkers
+            else:
+                n = epc if epc > 0 else nworkers
+            if st.max_tasks is not None:
+                n = min(n, st.max_tasks)
+            if any(self.dag.stage(i).output_node.kind == "gather"
+                   for i in st.inputs):
+                n = 1
+            self.ntasks[st.sid] = max(1, n)
+
+    def _nparts_out(self, stage: Stage) -> int:
+        if stage.consumer is None:
+            return 1                    # the coordinator's root gather
+        return self.ntasks[stage.consumer]
+
+    # -- the run -------------------------------------------------------
+    def run(self) -> Dict[int, dict]:
+        for stage in self.dag.stages:
+            self._run_stage(stage)
+        return self.sources
+
+    def _run_stage(self, stage: Stage) -> None:
+        s = self.s
+        session = s.session
+        sid = stage.sid
+        ntasks = self.ntasks[sid]
+        nout = self._nparts_out(stage)
+        STAGES_SCHEDULED.inc()
+        stage_sources = {str(i): self.sources[i] for i in stage.inputs}
+        tasks = [_STask(sid, part,
+                        exchange_task_key(self.qid, sid, part))
+                 for part in range(ntasks)]
+        trace = getattr(session, "trace", None)
+        trace_parent = trace.current() if trace is not None else None
+        worker_stats: List[List[NodeStats]] = []
+        timeout_s = float(session.get("remote_task_timeout"))
+
+        def alive(wi: int) -> bool:
+            det = s.failure_detector
+            return det is None or det.is_alive(s.workers[wi].base_uri)
+
+        def run_attempt(st: _STask, attempt: int, wi: int,
+                        speculative: bool = False) -> Optional[str]:
+            """One attempt of stage task ``st`` on worker ``wi``;
+            None on success OR benign loss to a sibling attempt."""
+            tid = f"{self.qid}.s{sid}.{st.part}.a{attempt}"
+            client = s.workers[wi]
+            t0 = time.perf_counter()
+            if not speculative:
+                with st.lock:
+                    st.running_since = t0
+                    st.running_worker = wi
+            try:
+                client.submit_fragment(
+                    tid, self.payloads[sid],
+                    catalog=session.catalog, schema=session.schema,
+                    part=st.part, nparts=ntasks,
+                    properties=dict(session.properties),
+                    collect_stats=s.collect_stats,
+                    attempt=attempt, spool=True,
+                    stage={"sid": sid, "exchange_key": st.key,
+                           "nparts_out": nout,
+                           "sources": stage_sources})
+                watch = _Watch(getattr(session, "cancel", None),
+                               st.done)
+                status = client.wait_done(tid, cancel=watch,
+                                          timeout_s=timeout_s)
+                if status.get("state") != "FINISHED":
+                    raise RuntimeError(
+                        f"task is {status.get('state')}: "
+                        f"{status.get('error') or 'no error recorded'}")
+            except Exception as e:      # noqa: BLE001
+                if not speculative:
+                    with st.lock:
+                        st.running_since = None
+                if st.done.is_set():
+                    if not st.failed:
+                        return None     # a sibling attempt already won
+                    return (f"stage {sid} fragment task {tid}: aborted "
+                            "(task already failed)")
+                cancel = getattr(session, "cancel", None)
+                if cancel is not None and cancel.is_set():
+                    return f"stage {sid} fragment task {tid}: canceled"
+                if s.failure_detector is not None:
+                    s.failure_detector.record_task_failure(
+                        client.base_uri, f"{type(e).__name__}: {e}")
+                with s._excl_lock:
+                    s.excluded.add(wi)
+                return (f"stage {sid} fragment task {tid} on worker "
+                        f"{client.base_uri}: {type(e).__name__}: {e}")
+            t1 = time.perf_counter()
+            if s.failure_detector is not None:
+                s.failure_detector.record_task_success(client.base_uri)
+            self.straggler.record(sid, t1 - t0)
+            won = False
+            with st.lock:
+                if st.winner is None:
+                    st.winner = (attempt, wi, speculative)
+                    won = True
+            if not won:
+                return None     # duplicate output: the spool's
+                #                 first-commit-wins already discarded it
+            # the winner MUST set st.done (finally): a crash in the
+            # best-effort telemetry would strand the untimed stage wait
+            try:
+                if speculative:
+                    with s._stats_lock:
+                        s.speculative_wins += 1
+                    SPECULATIVE_WINS.inc()
+                if s.collect_stats:
+                    reported = [NodeStats.from_dict(d) for d in
+                                status.get("nodeStats") or []]
+                    if reported:
+                        worker_stats.append(reported)
+                    with s._stats_lock:
+                        self.resources.append((
+                            int(status.get("peakMemoryBytes") or 0),
+                            int(status.get("spillBytes") or 0)))
+                    if trace is not None:
+                        sp = trace.record(
+                            f"stage_{sid}_execute", t0, t1,
+                            parent=trace_parent, worker=wi, task=tid,
+                            attempt=attempt, speculative=speculative)
+                        trace.graft(sp, status.get("spans") or [])
+            except Exception:   # noqa: BLE001 — telemetry best-effort
+                pass
+            finally:
+                st.done.set()
+            return None
+
+        def run_task(st: _STask) -> None:
+            failures = 0
+            attempt = st.next_attempt()
+            while True:
+                if attempt > 0:
+                    s._sync_workers()   # live membership: late joiners
+                with s._excl_lock:
+                    banned = frozenset(s.excluded)
+                wi = pick_worker(len(s.workers), st.part, attempt,
+                                 banned, alive)
+                try:
+                    err = run_attempt(st, attempt, wi)
+                except Exception as e:  # noqa: BLE001 — an attempt-path
+                    # bug must fail the task, not strand the stage wait
+                    err = (f"stage {sid} attempt {attempt}: internal: "
+                           f"{type(e).__name__}: {e}")
+                if err is None:
+                    return
+                failures += 1
+                st.errors.append(err)
+                cancel = getattr(session, "cancel", None)
+                canceled = cancel is not None and cancel.is_set()
+                if canceled or not self.controller.record_failure(
+                        (sid, st.part)):
+                    # out of attempts — but a healthy speculative
+                    # duplicate still in flight decides the task's
+                    # fate, not this exhausted primary
+                    with st.lock:
+                        spec_pending = (st.speculated
+                                        and st.winner is None)
+                    if spec_pending and not canceled:
+                        st.spec_done.wait()
+                    with st.lock:
+                        if st.winner is None:
+                            st.failed = True
+                    st.done.set()
+                    return
+                with s._stats_lock:
+                    s.task_retries += 1
+                TASK_RETRIES.inc()
+                if trace is not None:
+                    trace.record(f"stage_{sid}_retry",
+                                 time.perf_counter(),
+                                 time.perf_counter(),
+                                 parent=trace_parent, part=st.part,
+                                 worker=wi, attempt=attempt,
+                                 error=err[-160:])
+                delay = backoff_delay(self.policy, failures,
+                                      f"{self.qid}.s{sid}.{st.part}")
+                if st.done.wait(delay):
+                    return    # a speculative sibling won during backoff
+                attempt = st.next_attempt()
+
+        def run_speculative(st: _STask, attempt: int, wi: int) -> None:
+            try:
+                err = run_attempt(st, attempt, wi, speculative=True)
+                if err is not None:
+                    st.errors.append("[speculative] " + err)
+            except Exception as e:      # noqa: BLE001
+                st.errors.append("[speculative] internal: "
+                                 f"{type(e).__name__}: {e}")
+            finally:
+                st.spec_done.set()
+
+        def monitor(stop_ev: threading.Event) -> None:
+            while not stop_ev.wait(0.05):
+                pending = [st for st in tasks if not st.done.is_set()]
+                if not pending:
+                    return
+                for st in pending:
+                    if st.speculated:
+                        continue
+                    with st.lock:
+                        t0 = st.running_since
+                        wi_cur = st.running_worker
+                        settled = st.winner is not None
+                    if settled or t0 is None:
+                        continue
+                    elapsed = time.perf_counter() - t0
+                    if not self.straggler.is_straggler(sid, elapsed):
+                        continue
+                    if not self.controller.grant_speculation(
+                            (sid, st.part)):
+                        continue
+                    st.speculated = True
+                    attempt = st.next_attempt()
+                    s._sync_workers()
+                    with s._excl_lock:
+                        banned = frozenset(
+                            s.excluded
+                            | ({wi_cur} if wi_cur is not None
+                               else set()))
+                    wi = pick_worker(len(s.workers), st.part, attempt,
+                                     banned, alive)
+                    if wi == wi_cur:
+                        st.spec_done.set()   # nowhere better to run
+                        continue
+                    with s._stats_lock:
+                        s.speculative_launches += 1
+                    SPECULATIVE_TASKS.inc()
+                    if trace is not None:
+                        trace.record(f"stage_{sid}_speculate", t0,
+                                     time.perf_counter(),
+                                     parent=trace_parent, part=st.part,
+                                     attempt=attempt, worker=wi,
+                                     straggler_worker=wi_cur)
+                    threading.Thread(target=run_speculative,
+                                     args=(st, attempt, wi),
+                                     daemon=True).start()
+
+        for st in tasks:
+            threading.Thread(target=run_task, args=(st,),
+                             daemon=True).start()
+        stop_ev = threading.Event()
+        if self.speculation_on:
+            threading.Thread(target=monitor, args=(stop_ev,),
+                             daemon=True).start()
+        try:
+            for st in tasks:
+                st.done.wait()
+        finally:
+            stop_ev.set()
+        failed = [st for st in tasks if st.failed]
+        if failed:
+            from ..exec.executor import QueryError
+            raise QueryError(
+                "remote task failed: " + "; ".join(
+                    "; ".join(st.errors[-2:]) for st in failed[:3]))
+        self.sources[sid] = {  # tt-lint: ignore[race-attr-write] DAG-level maps are driver-thread-only: written between stage barriers, task threads never touch them
+            "tasks": [st.key for st in tasks],
+            "uris": [s.workers[st.winner[1]].base_uri
+                     if st.winner is not None else None
+                     for st in tasks]}
+        if s.collect_stats:
+            from ..exec.executor import merge_node_stats
+            self.stage_stats[sid] = merge_node_stats(worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage barrier
+            self.stage_reported[sid] = len(worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage barrier
