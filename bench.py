@@ -351,6 +351,91 @@ def _leg_mpp(iters: int) -> dict:
     }
 
 
+def _leg_load(duration_s: float, clients: int) -> dict:
+    """Closed-loop concurrency leg (ROADMAP item 2's tracked metric):
+    K concurrent protocol clients hammer one coordinator for a fixed
+    duration against a concurrency-capped resource group, so queries
+    queue, drain fair, and occasionally bounce off the full queue.
+    Reports QPS, p50/p95/p99 query wall (from the PR 4 histogram,
+    delta-snapshotted around the run), average queued time, and the
+    governance counters (rejections, memory kills) — overload behavior
+    as a number, like rows/s."""
+    import threading
+
+    import trino_tpu  # noqa: F401
+    from trino_tpu.client import ClientError, StatementClient
+    from trino_tpu.obs.metrics import (MEMORY_KILLS, QUEUE_REJECTIONS,
+                                       QUERY_QUEUED_SECONDS,
+                                       QUERY_WALL_SECONDS)
+    from trino_tpu.server.coordinator import Coordinator
+    from trino_tpu.server.resourcegroups import (ResourceGroup,
+                                                 ResourceGroupManager)
+
+    mgr = ResourceGroupManager()
+    grp = mgr.root.add(ResourceGroup(
+        "bench", hard_concurrency=2,
+        # smaller than the client count minus the running slots, so
+        # the burst occasionally trips QUERY_QUEUE_FULL — the
+        # rejection path is part of what this leg measures
+        max_queued=max(2, clients // 3)))
+    mgr.add_selector(grp)
+    co = Coordinator(resource_groups=mgr,
+                     memory_pool_bytes=4 << 30).start()
+    sql = "SELECT count(*) FROM tpch.tiny.region"
+    StatementClient(co.base_uri).execute(sql)     # warm the engine
+    wall0, n0, _ = QUERY_WALL_SECONDS.snapshot()
+    q0, qn0, qs0 = QUERY_QUEUED_SECONDS.snapshot()
+    rej0 = QUEUE_REJECTIONS.value()
+    kills0 = MEMORY_KILLS.value()
+    completed = [0] * clients
+    rejected = [0] * clients
+    stop_at = time.monotonic() + duration_s
+
+    def run(i: int):
+        c = StatementClient(co.base_uri)
+        while time.monotonic() < stop_at:
+            try:
+                c.execute(sql)
+                completed[i] += 1
+            except ClientError as e:
+                if "QUERY_QUEUE_FULL" in str(e):
+                    rejected[i] += 1
+                    time.sleep(0.02)    # back off like a real client
+                else:
+                    raise
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    wall1, n1, _ = QUERY_WALL_SECONDS.snapshot()
+    _, qn1, qs1 = QUERY_QUEUED_SECONDS.snapshot()
+    co.stop()
+    deltas = [b - a for a, b in zip(wall0, wall1)]
+    n = n1 - n0
+    pct = lambda q: QUERY_WALL_SECONDS.quantile_from_deltas(  # noqa: E731
+        QUERY_WALL_SECONDS.buckets, deltas, n, q)
+    qcount = qn1 - qn0
+    return {
+        "qps": sum(completed) / max(elapsed, 1e-9),
+        "clients": clients,
+        "duration_s": round(elapsed, 2),
+        "completed": sum(completed),
+        "p50_ms": round(pct(0.50) * 1000, 2),
+        "p95_ms": round(pct(0.95) * 1000, 2),
+        "p99_ms": round(pct(0.99) * 1000, 2),
+        "queued_ms_avg": round(
+            (qs1 - qs0) / qcount * 1000, 2) if qcount else 0.0,
+        "queued_dequeues": qcount,
+        "rejections": (QUEUE_REJECTIONS.value() - rej0),
+        "memory_kills": (MEMORY_KILLS.value() - kills0),
+    }
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -385,13 +470,14 @@ def _run_probe_body(kind: str):
                 ("micro", lambda: _leg_micro(0.1, 2)),
                 ("telemetry", lambda: _leg_telemetry("sf1", 2)),
                 ("fault", lambda: _leg_fault(2)),
-                ("mpp", lambda: _leg_mpp(2))]
+                ("mpp", lambda: _leg_mpp(2)),
+                ("load", lambda: _leg_load(6.0, 6))]
     for name, fn in legs:
         try:
             if name == "telemetry":
                 print(json.dumps(
                     {"leg": name, "overhead": fn()}), flush=True)
-            elif name in ("fault", "mpp"):
+            elif name in ("fault", "mpp", "load"):
                 print(json.dumps(dict({"leg": name}, **fn())),
                       flush=True)
             else:
@@ -449,6 +535,13 @@ def _probe(kind: str, timeout: float):
                 errs["init"] = ("no accelerator: platform="
                                 f"{d.get('platform')} x"
                                 f"{d.get('device_count')}")
+        elif "qps" in d:
+            # load leg ride-alongs: the concurrency scoreboard
+            vals["load"] = d["qps"]
+            for k in ("p50_ms", "p95_ms", "p99_ms", "queued_ms_avg",
+                      "rejections", "memory_kills", "completed"):
+                if k in d:
+                    vals[f"load_{k}"] = d[k]
         elif "rows_per_sec" in d:
             vals[d.get("leg", "?")] = d["rows_per_sec"]
             # mpp leg ride-alongs: worker-side execution artifacts
@@ -472,7 +565,7 @@ def _probe(kind: str, timeout: float):
     expected = ("init",) if kind == "init" else \
         ("q18",) if kind == "scale" else \
         ("engine", "micro", "telemetry") + \
-        (("fault", "mpp") if kind == "cpu" else ())
+        (("fault", "mpp", "load") if kind == "cpu" else ())
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -605,6 +698,22 @@ def main():
             cpu_vals.get("mpp_speedup", 0.0) or 0.0, 2),
         "mpp_exchange_bytes": round(
             cpu_vals.get("mpp_exchange_bytes", 0.0) or 0.0, 1),
+        # overload governance (server/resourcegroups.py + memory.py):
+        # closed-loop load — K concurrent clients for a fixed duration
+        # against a hard_concurrency=2 group. QPS + latency percentiles
+        # from the query-wall histogram, average admission queue wait,
+        # and the governance counters the run drove (ROADMAP item 2's
+        # concurrency metric, tracked like rows/s)
+        "load_qps": round(cpu_vals.get("load", 0.0) or 0.0, 2),
+        "load_p50_ms": round(cpu_vals.get("load_p50_ms", 0.0) or 0.0, 2),
+        "load_p95_ms": round(cpu_vals.get("load_p95_ms", 0.0) or 0.0, 2),
+        "load_p99_ms": round(cpu_vals.get("load_p99_ms", 0.0) or 0.0, 2),
+        "load_queued_ms_avg": round(
+            cpu_vals.get("load_queued_ms_avg", 0.0) or 0.0, 2),
+        "load_rejections": round(
+            cpu_vals.get("load_rejections", 0.0) or 0.0, 1),
+        "load_memory_kills": round(
+            cpu_vals.get("load_memory_kills", 0.0) or 0.0, 1),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale. sf100 lineitem

@@ -61,8 +61,9 @@ Cross-module reachability (``lint_paths`` multi-file runs): thread
 seeds stay module-local, but a reachable ``obj.m(...)`` call is ALSO
 resolved by method name against classes of the SHARED-RUNTIME callee
 modules (``_CROSS_CALLEES``: ``fte/``, ``stage/``, ``obs/metrics.py``,
-``obs/trace.py``, ``server/failure.py``) with the caller's lock
-context propagated — so the scheduler-thread -> ``fte/spool.py``
+``obs/trace.py``, ``server/failure.py``,
+``server/resourcegroups.py``, ``server/memory.py``) with the
+caller's lock context propagated — so the scheduler-thread -> ``fte/spool.py``
 edges (``spool.commit``/``release`` from dispatch threads) are
 followed and a spool-side unlocked write is flagged in the spool's
 file. The callee set is deliberately an allowlist: name-based
@@ -227,9 +228,14 @@ class _ModuleIndex(ast.NodeVisitor):
 
 # shared-runtime modules whose methods thread code in OTHER modules
 # calls by design: cross-module edges are followed into these (and only
-# these — see the module docstring for why this is an allowlist)
+# these — see the module docstring for why this is an allowlist).
+# resourcegroups + memory joined in PR 10: admission/dequeue and pool
+# reservation bookkeeping run on dispatch threads (QueryTracker's
+# per-query threads call groups.query_finished and memory.reserve
+# concurrently), so their lock discipline must stay lint-reachable.
 _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
-                  "server/failure.py")
+                  "server/failure.py", "server/resourcegroups.py",
+                  "server/memory.py")
 
 
 class _CrossIndex:

@@ -180,6 +180,21 @@ def error_info(name: str) -> Tuple[int, str]:
         name, STANDARD_ERROR_CODES["GENERIC_INTERNAL_ERROR"])
 
 
+def http_status_for(error_type: str) -> int:
+    """HTTP status for a non-protocol error surface (the statement
+    protocol itself always carries errors in a 200 QueryResults
+    payload, like the reference). USER_ERROR maps to 400,
+    INSUFFICIENT_RESOURCES to 429 (the governance layer's admission
+    rejections and memory kills are back-pressure, not server bugs —
+    a bare 500 would make clients treat "queue full" as an outage),
+    everything else stays 500."""
+    if error_type == USER_ERROR:
+        return 400
+    if error_type == INSUFFICIENT_RESOURCES:
+        return 429
+    return 500
+
+
 def classify(exc: BaseException) -> Tuple[str, int, str]:
     """(errorName, errorCode, errorType) for an engine exception —
     the coordinator's failure-info mapping (reference:
@@ -216,6 +231,17 @@ def _name_for(exc: BaseException) -> str:
         return "NOT_FOUND"
     if "already exists" in low:
         return "ALREADY_EXISTS"
+    # governance errors (server/memory.py, server/resourcegroups.py):
+    # BEFORE the "canceled" sniff — the killer's message says it
+    # "canceled query X", which is a memory kill, not a user cancel —
+    # and before the generic memory fallback
+    if "cluster is out of memory" in low or "low-memory killer" in low:
+        return "CLUSTER_OUT_OF_MEMORY"
+    if "global memory limit" in low or "memory pool" in low:
+        return "EXCEEDED_GLOBAL_MEMORY_LIMIT"
+    if "maximum run time" in low or ("time limit" in low
+                                     and "exceed" in low):
+        return "EXCEEDED_TIME_LIMIT"
     if "canceled" in low:
         return "USER_CANCELED"
     if "division by zero" in low:

@@ -46,7 +46,16 @@ from .expr import EvalError, eval_expr, eval_predicate
 
 
 class QueryError(Exception):
-    pass
+    """Engine/user-facing failure. ``error_name`` (when set) pins the
+    StandardErrorCode name for errors.classify — governance errors
+    (memory kills, deadline breaches) must reach the client with their
+    Trino identity, not a message-sniffed guess."""
+
+    def __init__(self, message: str,
+                 error_name: "Optional[str]" = None):
+        super().__init__(message)
+        if error_name is not None:
+            self.error_name = error_name
 
 
 class _Pre(PlanNode):
@@ -381,6 +390,14 @@ class Executor:
             # cooperative cancellation between plan nodes (reference:
             # Driver loop checks the yield/termination signal)
             raise QueryError("Query was canceled")
+        deadline = getattr(self.session, "deadline", None)
+        if deadline is not None and time.monotonic() > deadline:
+            # deadline enforcement at the same granularity as cancel:
+            # a breach stops execution between plan nodes instead of
+            # waiting for the coordinator's next poll
+            raise QueryError(
+                "Query exceeded the maximum run time "
+                "(query_max_run_time)", error_name="EXCEEDED_TIME_LIMIT")
         if not self.collect_stats:
             return self._execute_inner(node)
         return self._stats_wrap(node, lambda: self._execute_inner(node))
@@ -1191,6 +1208,21 @@ class Executor:
         # reported in QueryCompletedEvent (capacity planning is the one
         # allocation decision point in this engine — config.py)
         self.peak_reserved_bytes = max(self.peak_reserved_bytes, est)
+        mem = getattr(self.session, "memory", None)
+        if mem is not None:
+            # cluster memory governance (server/memory.py): the same
+            # estimate feeds the coordinator's pool ledger; a per-query
+            # cap breach or a low-memory kill of THIS query raises
+            # here, in the reserving thread, with its Trino error name.
+            # ONLY governance errors are rewrapped — an internal bug in
+            # the manager must surface as an internal error, not
+            # masquerade as a memory-limit breach
+            from ..server.memory import MemoryGovernanceError
+            try:
+                mem.reserve(est)
+            except MemoryGovernanceError as e:
+                raise QueryError(str(e),
+                                 error_name=e.error_name) from e
 
     def _oversized_join(self, probe: Batch, build: Batch, start, count,
                         eff, order, total: int, width: int,

@@ -166,6 +166,41 @@ class RemoteScheduler:
         self.stage_dag = None
         self.stage_lines: List[str] = []
 
+    # -- deadline propagation ------------------------------------------
+    def _remaining_s(self) -> Optional[float]:
+        """Seconds left in this query's wall-clock budget (None = no
+        deadline). The deadline is ABSOLUTE (session.deadline, set by
+        the tracker/runner from query_max_run_time) so every dispatch,
+        retry backoff, and page pull shares one shrinking budget —
+        one computation, owned by Session.remaining_time."""
+        rem = getattr(self.session, "remaining_time", None)
+        return rem() if callable(rem) else None
+
+    def _attempt_budget_s(self, default_s: float) -> float:
+        """Per-attempt timeout bounded by the remaining query budget —
+        an attempt must never outlive its query's deadline."""
+        rem = self._remaining_s()
+        if rem is None:
+            return default_s
+        return max(0.05, min(default_s, rem))
+
+    def _check_deadline(self, where: str) -> None:
+        """Raise EXCEEDED_TIME_LIMIT once the budget is spent; records
+        a ``deadline_cancel`` span so the trace shows WHERE the breach
+        cut execution (schedule, retry, combine...)."""
+        import time as _time
+        rem = self._remaining_s()
+        if rem is None or rem > 0:
+            return
+        trace = getattr(self.session, "trace", None)
+        if trace is not None:
+            now = _time.perf_counter()
+            trace.record("deadline_cancel", now, now, where=where)
+        raise QueryError(
+            f"Query exceeded the maximum run time "
+            f"(query_max_run_time) during {where}",
+            error_name="EXCEEDED_TIME_LIMIT")
+
     def _sync_workers(self) -> None:
         """Append clients for workers that joined since dispatch.
         Append-only: positions of known workers never move (attempt
@@ -295,6 +330,7 @@ class RemoteScheduler:
         payloads: Dict[int, dict] = {}
         dag = stage_payloads = None
         with sp("schedule"):
+            self._check_deadline("schedule")
             checker.validate(plan, "pre-dispatch")
             if self._multistage_enabled():
                 from ..stage.fragmenter import StageFragmenter
@@ -447,6 +483,10 @@ class RemoteScheduler:
                     if policy.enabled else 1)
         trace = getattr(self.session, "trace", None)
         for attempt in range(attempts):
+            # the deadline bounds the combine retry loop too: a root
+            # re-execution past the budget answers nobody
+            self._check_deadline("combine" if attempt == 0
+                                 else "combine retry")
             ex = Executor(self.catalogs, self.session,
                           self.collect_stats)
             if setup is not None:
@@ -471,8 +511,11 @@ class RemoteScheduler:
                     trace.record("combine_retry", t0,
                                  _time.perf_counter(), attempt=attempt,
                                  error=f"{type(e).__name__}: {e}"[-160:])
-                _time.sleep(backoff_delay(policy, attempt + 1,
-                                          "combine"))
+                delay = backoff_delay(policy, attempt + 1, "combine")
+                rem = self._remaining_s()
+                if rem is not None:
+                    delay = min(delay, max(rem, 0.0))
+                _time.sleep(delay)
         raise AssertionError("unreachable")  # loop returns or raises
 
     def _run_fragments(self, frags: List[_Fragment],
@@ -553,7 +596,12 @@ class RemoteScheduler:
                     part=st.part, nparts=nparts,
                     properties=dict(session.properties),
                     collect_stats=self.collect_stats,
-                    attempt=attempt, spool=spool is not None)
+                    attempt=attempt, spool=spool is not None,
+                    # the worker re-derives an absolute deadline from
+                    # the remaining budget: its own executor stops
+                    # between plan nodes instead of computing a result
+                    # nobody will wait for
+                    deadline_s=self._remaining_s())
                 # the watch event aborts this attempt's page pull the
                 # moment a sibling attempt wins (or the user cancels)
                 watch = _MultiEvent(getattr(session, "cancel", None),
@@ -561,7 +609,8 @@ class RemoteScheduler:
                 meta: Dict[str, str] = {}
                 frames = client.pages_raw(
                     tid, cancel=watch,
-                    timeout_s=float(session.get("remote_task_timeout")),
+                    timeout_s=self._attempt_budget_s(
+                        float(session.get("remote_task_timeout"))),
                     meta_out=meta)
             except Exception as e:     # noqa: BLE001
                 st.last_window = (t0, _time.perf_counter())
@@ -727,6 +776,12 @@ class RemoteScheduler:
                 st.errors.append(err)
                 cancel = getattr(session, "cancel", None)
                 canceled = cancel is not None and cancel.is_set()
+                rem = self._remaining_s()
+                if rem is not None and rem <= 0:
+                    # the deadline outranks the retry budget: a retry
+                    # past it would only burn worker time the client
+                    # has already given up on
+                    canceled = True
                 if canceled or not controller.record_failure(
                         (st.fragment.fid, st.part)):
                     # out of attempts — but first-completion-wins cuts
@@ -756,6 +811,8 @@ class RemoteScheduler:
                 delay = backoff_delay(
                     policy, failures,
                     f"{qid}.{st.fragment.fid}.{st.part}")
+                if rem is not None:
+                    delay = min(delay, max(rem, 0.0))
                 if st.done.wait(delay):
                     return   # a speculative sibling won during backoff
                 attempt = st.next_attempt()
@@ -799,6 +856,9 @@ class RemoteScheduler:
                     if not straggler.is_straggler(st.fragment.fid,
                                                   elapsed):
                         continue
+                    rem = self._remaining_s()
+                    if rem is not None and rem <= 0:
+                        continue     # past the deadline: no new work
                     if not controller.grant_speculation(
                             (st.fragment.fid, st.part)):
                         continue

@@ -151,6 +151,38 @@ class Histogram(_Metric):
             h = self._hist.get(self._key(labels))
             return h[-2] if h else 0.0
 
+    def snapshot(self, **labels) -> Tuple[Tuple[float, ...], float,
+                                          float]:
+        """(cumulative bucket counts, total count, sum) — the readback
+        half of the histogram for in-process consumers (the bench load
+        leg computes percentile deltas between two snapshots rather
+        than re-parsing its own exposition text)."""
+        with self._lock:
+            h = self._hist.get(self._key(labels))
+            if h is None:
+                return (0.0,) * len(self.buckets), 0.0, 0.0
+            return tuple(h[:len(self.buckets)]), h[-2], h[-1]
+
+    @staticmethod
+    def quantile_from_deltas(buckets: Sequence[float],
+                             deltas: Sequence[float], count: float,
+                             q: float) -> float:
+        """Estimate the q-quantile from cumulative-bucket-count deltas
+        (Prometheus histogram_quantile semantics: linear interpolation
+        within the containing bucket, clamped to the largest finite
+        bucket bound for the +Inf tail)."""
+        if count <= 0:
+            return 0.0
+        rank = q * count
+        prev_bound, prev_cum = 0.0, 0.0
+        for bound, cum in zip(buckets, deltas):
+            if cum >= rank:
+                span = cum - prev_cum
+                frac = ((rank - prev_cum) / span) if span > 0 else 1.0
+                return prev_bound + (bound - prev_bound) * frac
+            prev_bound, prev_cum = bound, cum
+        return buckets[-1] if buckets else 0.0
+
     def render(self) -> List[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} {self.kind}"]
@@ -279,6 +311,33 @@ PLAN_VALIDATION_FAILURES = METRICS.counter(
 # partition of upstream tasks (stage/repartition.py, stage/exchange.py)
 # — defined here because the two directions live in different modules
 # and their identity must not drift.
+# overload governance (server/resourcegroups.py + server/memory.py):
+# admission queueing, the cluster memory pool, and deadline
+# enforcement. Defined here because producers span modules (tracker,
+# group manager, memory manager, remote scheduler) and the bench load
+# leg re-reads them — one identity, no drift.
+QUERY_QUEUED_SECONDS = METRICS.histogram(
+    "trino_tpu_query_queued_seconds",
+    "Time queries spent queued in resource-group admission before "
+    "starting")
+QUEUE_REJECTIONS = METRICS.counter(
+    "trino_tpu_queue_rejections_total",
+    "Queries rejected at admission because the group queue was full "
+    "(QUERY_QUEUE_FULL)")
+MEMORY_POOL_BYTES = METRICS.gauge(
+    "trino_tpu_memory_pool_bytes",
+    "Cluster memory pool state in bytes", ("kind",))   # total|reserved
+MEMORY_POOL_QUERIES = METRICS.gauge(
+    "trino_tpu_memory_pool_queries",
+    "Queries currently holding a cluster memory pool reservation")
+MEMORY_KILLS = METRICS.counter(
+    "trino_tpu_memory_kills_total",
+    "Queries killed by the low-memory killer (CLUSTER_OUT_OF_MEMORY)")
+DEADLINE_CANCELS = METRICS.counter(
+    "trino_tpu_deadline_cancels_total",
+    "Queries canceled for exceeding query_max_run_time "
+    "(EXCEEDED_TIME_LIMIT)")
+
 EXCHANGE_PARTITIONS = METRICS.counter(
     "trino_tpu_exchange_partitions_total",
     "Partitioned-exchange frames by direction", ("direction",))

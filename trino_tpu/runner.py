@@ -113,6 +113,17 @@ class LocalQueryRunner:
         sp = trace.span if trace is not None else null_span
         prev_trace = self.session.trace
         self.session.trace = trace
+        # deadline derivation for standalone runs: the coordinator's
+        # tracker stamps session.deadline before dispatch; a runner
+        # used directly derives it here so query_max_run_time is
+        # enforced (executor checks between plan nodes) without a
+        # tracker above it
+        owned_deadline = False
+        if self.session.deadline is None:
+            limit = int(self.session.get("query_max_run_time") or 0)
+            if limit > 0:
+                self.session.deadline = time.monotonic() + limit
+                owned_deadline = True
         try:
             try:
                 with sp("parse"):
@@ -136,6 +147,8 @@ class LocalQueryRunner:
         finally:
             self.session.trace = prev_trace
             self.session.query_id = ""
+            if owned_deadline:
+                self.session.deadline = None
             # observed for failed/canceled queries too — the slowest
             # queries are exactly the ones that time out, and a latency
             # histogram that drops them reads optimistic at p99

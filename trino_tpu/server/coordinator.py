@@ -70,9 +70,22 @@ class _Query:
     error: Optional[dict] = None
     result: Optional[QueryResult] = None
     created: float = field(default_factory=time.time)
+    started: Optional[float] = None   # admission granted (left queue)
     ended: Optional[float] = None     # set at terminal transition
     source: str = ""
     group: Optional[object] = None   # assigned ResourceGroup
+    # True when admission actually queued this query (gates the
+    # post-hoc "queued" span: an immediately-admitted query's span
+    # tree stays parse/plan/optimize/execute)
+    admission_queued: bool = False
+    # monotonic submit stamp: query_max_run_time budgets the WHOLE
+    # run including queued time (the reference's QUERY_MAX_RUN_TIME,
+    # as opposed to max_execution_time), so the deadline anchors here
+    submit_mono: float = field(default_factory=time.monotonic)
+    # the armed deadline timer (set at SUBMIT, not at dequeue: a query
+    # that spends its whole budget QUEUED must die at t=limit, like
+    # the reference's enforceTimeLimits covering queued queries)
+    deadline_timer: Optional[threading.Timer] = None
     _done: threading.Event = field(default_factory=threading.Event)
     _cancel: threading.Event = field(default_factory=threading.Event)
     _state_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -135,12 +148,45 @@ class _Query:
                 self.ended = time.time()  # tt-lint: ignore[race-attr-write] benign last-write with do_cancel's stamp; both are wall-clock end times
             self._done.set()
 
+    def _retire_deadline_timer(self):
+        """A terminal query never needs its armed deadline timer again;
+        leaving it would pin this query (and its Session) in a sleeping
+        Timer thread for up to query_max_run_time — per canceled
+        queued query, under exactly the overload this layer is for.
+        (Timer.cancel from within its own callback is a no-op.)"""
+        if self.deadline_timer is not None:
+            self.deadline_timer.cancel()
+
     def do_cancel(self):
         self._cancel.set()
         if self._transition("CANCELED"):
             if self.ended is None:
                 self.ended = time.time()  # tt-lint: ignore[race-attr-write] benign last-write with run's finally stamp; both are wall-clock end times
             self._done.set()
+        self._retire_deadline_timer()
+
+    def kill(self, message: str,
+             error_name: str = "ADMINISTRATIVELY_KILLED") -> bool:
+        """Engine-initiated termination (low-memory killer, deadline
+        breach): unlike a user cancel this is a FAILURE carrying a
+        specific error identity — the client must learn WHY the
+        engine stopped its query, not just that it stopped. Sets the
+        cancel event so the executor and every remote page pull /
+        status watch abort their in-flight work cooperatively."""
+        from ..errors import error_info
+        code, etype = error_info(error_name)
+        with self._state_lock:
+            if self.state in ("FINISHED", "FAILED", "CANCELED"):
+                return False
+            self.state = "FAILED"
+            self.error = {"message": message, "errorCode": code,
+                          "errorName": error_name, "errorType": etype}
+        self._cancel.set()
+        if self.ended is None:
+            self.ended = time.time()  # tt-lint: ignore[race-attr-write] benign last-write with run's finally stamp; both are wall-clock end times
+        self._done.set()
+        self._retire_deadline_timer()
+        return True
 
     def wait_done(self, timeout: float) -> bool:
         return self._done.wait(timeout)
@@ -154,7 +200,7 @@ class QueryTracker:
     lifecycle events (event/QueryMonitor.java:130,206)."""
 
     def __init__(self, make_runner, events=None, resource_groups=None,
-                 result_store=None):
+                 result_store=None, memory=None):
         from .events import EventListenerManager
         self._queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
@@ -162,6 +208,12 @@ class QueryTracker:
         self._make_runner = make_runner
         self.events = events or EventListenerManager()
         self.groups = resource_groups
+        # cluster memory governance (server/memory.py
+        # ClusterMemoryManager): every dispatched query registers a
+        # reservation context (fed by Executor._reserve) with its
+        # group's soft limit and a kill callback — the low-memory
+        # killer's handle on the query
+        self.memory = memory
         # coordinator-restart recovery (fte/recovery.py): finished
         # queries persist their combine output + manifest here so a
         # client can re-pull results from a NEW coordinator process
@@ -186,16 +238,54 @@ class QueryTracker:
         self.events.query_created(QueryCreatedEvent(
             qid, sql, session.user, session.catalog, session.schema))
 
+        limit = int(session.get("query_max_run_time") or 0)
+        if limit > 0:
+            # QUERY_MAX_RUN_TIME enforcement, armed at SUBMIT: the
+            # budget covers the whole run INCLUDING queued time, as an
+            # absolute deadline — a query that burns its budget
+            # sitting QUEUED dies at t=limit, not at dequeue+limit.
+            # The session carries the deadline so the executor
+            # (between plan nodes), the remote scheduler (attempt
+            # timeouts, retry/speculation grants, backoff), and
+            # worker-side executors (deadline_s in the task payload)
+            # all enforce the same shrinking budget; the timer is the
+            # coordinator-side backstop that fails the query with
+            # EXCEEDED_TIME_LIMIT and — via the cancel event — aborts
+            # in-flight remote attempts on workers instead of waiting
+            # for the next client poll.
+            from ..obs.metrics import DEADLINE_CANCELS
+            session.deadline = q.submit_mono + limit
+
+            def deadline_fire():
+                if q.kill(
+                        f"Query exceeded the maximum run time of "
+                        f"{limit}s (query_max_run_time)",
+                        "EXCEEDED_TIME_LIMIT"):
+                    DEADLINE_CANCELS.inc()
+                    self._withdraw_if_queued(q)
+
+            q.deadline_timer = threading.Timer(
+                max(session.deadline - time.monotonic(), 0.001),
+                deadline_fire)
+            q.deadline_timer.daemon = True
+            q.deadline_timer.start()
+
         def run_and_release():
-            timer = None
-            limit = int(session.get("query_max_run_time") or 0)
-            if limit > 0:
-                # QUERY_MAX_RUN_TIME enforcement: cooperative cancel
-                # after the wall-clock budget (the executor polls the
-                # cancel event between plan nodes)
-                timer = threading.Timer(limit, q.do_cancel)
-                timer.daemon = True
-                timer.start()
+            q.started = time.time()  # tt-lint: ignore[race-attr-write] single stamp before the query publishes; readers tolerate None
+            if self.memory is not None:
+                # cluster memory governance: the pool ledger tracks
+                # this query from first reservation to completion; the
+                # group's soft limit and the per-query cap ride along
+                session.memory = self.memory.register(
+                    qid,
+                    group=getattr(q.group, "full_name", "global")
+                    if q.group is not None else "global",
+                    kill_fn=q.kill,
+                    group_limit_bytes=getattr(
+                        q.group, "soft_memory_limit_bytes", 0) or 0
+                    if q.group is not None else 0,
+                    query_limit_bytes=int(
+                        session.get("query_max_memory") or 0))
             _M_STATES.inc(state="RUNNING")
             persist = discard = None
             if self.results is not None:
@@ -215,10 +305,24 @@ class QueryTracker:
                 q.run(self._make_runner, on_result=persist,
                       on_discard=discard)
             finally:
-                if timer is not None:
-                    timer.cancel()
+                if q.deadline_timer is not None:
+                    q.deadline_timer.cancel()
+                if self.memory is not None:
+                    self.memory.unregister(qid)
+                    session.memory = None
                 if q.group is not None and self.groups is not None:
                     self.groups.query_finished(q.group)
+                # queue-wait span: grafted post-hoc (the trace is born
+                # inside the runner, after dequeue) so /v1/query shows
+                # admission latency next to parse/plan/execute
+                queued_s = ((q.started or q.created) - q.created)
+                tr = getattr(q.result, "trace", None) \
+                    if q.result is not None else None
+                if tr is not None and q.admission_queued \
+                        and queued_s > 0:
+                    tr.record("queued", tr.origin_s - queued_s,
+                              tr.origin_s, group=getattr(
+                                  q.group, "full_name", ""))
                 _M_STATES.inc(state=q.state)
                 if self.results is not None:
                     try:
@@ -263,6 +367,15 @@ class QueryTracker:
             # fast-finishing query cannot race past run_and_release's
             # slot release (q.group would still be None)
             q.group = group
+            with q._state_lock:
+                dead = q.state in ("FINISHED", "FAILED", "CANCELED")
+            if dead and group is not None and self.groups is not None:
+                # a dequeued entry whose query already died (deadline
+                # kill / cancel racing the withdrawal): release the
+                # just-taken slot instead of spending a thread on a
+                # query that will no-op
+                self.groups.query_finished(group)
+                return
             t = threading.Thread(target=run_and_release, daemon=True,
                                  name=f"query-{qid}")
             # tag for the leak detector: a thread outliving its
@@ -275,13 +388,28 @@ class QueryTracker:
             start()
         else:
             try:
-                self.groups.submit(session.user, source, start,
-                                   tag=qid)
+                _, started_now = self.groups.submit(
+                    session.user, source, start, tag=qid)
+                if not started_now:
+                    q.admission_queued = True
             except QueryQueueFullError as e:
-                q.error = {"message": str(e), "errorCode": 131075,
+                # protocol-correct rejection: the Trino error name with
+                # ITS code and INSUFFICIENT_RESOURCES type (was a
+                # hand-typed — and wrong — literal code), flowing to
+                # the client as a FAILED QueryResults payload instead
+                # of a bare 500
+                if q.deadline_timer is not None:
+                    q.deadline_timer.cancel()
+                from ..errors import error_info
+                code, etype = error_info("QUERY_QUEUE_FULL")
+                q.error = {"message": str(e), "errorCode": code,
                            "errorName": "QUERY_QUEUE_FULL",
-                           "errorType": "INSUFFICIENT_RESOURCES"}
+                           "errorType": etype}
                 q._transition("FAILED")
+                # terminal stamp: without it queuedTimeMillis /
+                # elapsedTimeMillis grow on every poll of a query
+                # that was rejected instantly
+                q.ended = time.time()
                 q._done.set()
                 self.events.query_completed(QueryCompletedEvent(
                     q.query_id, q.sql, q.session.user, "FAILED",
@@ -306,7 +434,17 @@ class QueryTracker:
         if q is None:
             return False
         q.do_cancel()
+        self._withdraw_if_queued(q)
         return True
+
+    def _withdraw_if_queued(self, q: _Query) -> None:
+        """A query terminated before admission must leave its group's
+        queue: a dead entry holds max_queued capacity and would later
+        burn a concurrency slot. ``started is None`` = never dequeued;
+        the dequeue-side terminal check in submit's start() covers the
+        race where admission wins."""
+        if self.groups is not None and q.started is None:
+            self.groups.remove_queued(q.query_id)
 
 
 class Coordinator:
@@ -317,7 +455,8 @@ class Coordinator:
                  catalogs=None, resource_groups=None,
                  event_listeners=None, authenticator=None,
                  worker_uris=None, failure_detector=None,
-                 spool=None, spool_backend: Optional[str] = None):
+                 spool=None, spool_backend: Optional[str] = None,
+                 memory_pool_bytes: Optional[int] = None):
         from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
@@ -400,10 +539,30 @@ class Coordinator:
         events = EventListenerManager()
         for listener in (event_listeners or []):
             events.add_listener(listener)
+        if resource_groups is None:
+            # admission is ALWAYS real (ROADMAP item 2: the group tree
+            # was "mostly decorative" when it only existed if the
+            # operator passed one): a default manager routes every
+            # query through the root group's hard_concurrency /
+            # max_queued gates with the same defaults as before
+            from .resourcegroups import ResourceGroupManager
+            resource_groups = ResourceGroupManager()
         self.resource_groups = resource_groups
+        # cluster memory pool (server/memory.py): arg beats config;
+        # 0 disables governance (per-node query limits still apply)
+        from ..config import CONFIG as _CONFIG
+        pool_bytes = (memory_pool_bytes
+                      if memory_pool_bytes is not None
+                      else _CONFIG.cluster_memory_pool_bytes)
+        self.memory = None
+        if pool_bytes and pool_bytes > 0:
+            from .memory import ClusterMemoryManager, ClusterMemoryPool
+            self.memory = ClusterMemoryManager(
+                ClusterMemoryPool(int(pool_bytes)))
         self.tracker = QueryTracker(make_runner, events,
                                     resource_groups,
-                                    result_store=self.results)
+                                    result_store=self.results,
+                                    memory=self.memory)
         self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
@@ -588,7 +747,16 @@ class Coordinator:
                       "queued": q.state == "QUEUED",
                       "scheduled": q.state in ("RUNNING", "FINISHED"),
                       "elapsedTimeMillis":
-                          int((time.time() - q.created) * 1000)},
+                          int((time.time() - q.created) * 1000),
+                      # admission latency: how long the query sat in
+                      # its resource group's queue (still growing
+                      # while QUEUED — the client watches back-
+                      # pressure build in its nextUri polls; frozen at
+                      # q.ended for queries that died without starting,
+                      # e.g. queue-full rejections)
+                      "queuedTimeMillis": int(
+                          ((q.started or q.ended or time.time())
+                           - q.created) * 1000)},
             "warnings": [],
         }
         if q.state == "FAILED":
@@ -641,6 +809,9 @@ class Coordinator:
                                      time.localtime(q.created)),
             "elapsedTimeMillis": int(
                 ((q.ended or time.time()) - q.created) * 1000),
+            "queuedTimeMillis": int(
+                ((q.started or q.ended or time.time()) - q.created)
+                * 1000),
             "error": q.error,
         }
         if q.result is not None:
@@ -943,9 +1114,22 @@ def _make_handler(co: Coordinator):
                     if "=" in kv:
                         name, v = kv.split("=", 1)
                         session.prepared[name.strip()] = unquote(v)
-                q = co.tracker.submit(
-                    sql, session,
-                    source=self.headers.get("X-Trino-Source", ""))
+                try:
+                    q = co.tracker.submit(
+                        sql, session,
+                        source=self.headers.get("X-Trino-Source", ""))
+                except Exception as e:   # noqa: BLE001 — a submission
+                    # failure outside the tracked-query machinery
+                    # (selector bug, bad session property) must answer
+                    # with a classified error + mapped status, never
+                    # the handler's bare 500 traceback
+                    from ..errors import classify, http_status_for
+                    name, code, etype = classify(e)
+                    self._send(http_status_for(etype), {
+                        "error": {"message": str(e), "errorCode": code,
+                                  "errorName": name,
+                                  "errorType": etype}})
+                    return
                 q.wait_done(0.05)   # fast queries answer immediately
                 self._send(200, co.query_results(q, 0))
                 return
@@ -985,13 +1169,18 @@ def _make_handler(co: Coordinator):
                 return
             if path == "/v1/cluster":
                 qs = co.tracker.all()
-                self._send(200, {
+                out = {
                     "runningQueries": sum(
                         1 for q in qs if q.state == "RUNNING"),
                     "queuedQueries": sum(
                         1 for q in qs if q.state == "QUEUED"),
                     "totalQueries": len(qs),
-                    "activeWorkers": len(co.node_infos())})
+                    "activeWorkers": len(co.node_infos())}
+                if co.memory is not None:
+                    # memory-pool state rides the cluster overview
+                    # (webapp ClusterStats reservedMemory analog)
+                    out["memory"] = co.memory.info()
+                self._send(200, out)
                 return
             if path == "/v1/info":
                 self._send(200, co.info())

@@ -140,13 +140,24 @@ def main(argv=None) -> int:
     spool_backend = (args.spool_backend
                      or props.get("spool.backend") or None)
 
+    # cluster memory pool sizing (server/memory.py): config.properties
+    # query.max-memory (the reference's property name, accepting its
+    # DataSize strings — "50GB" — as well as raw bytes) beats the env
+    # default TRINO_TPU_CLUSTER_MEMORY_POOL; None keeps the config
+    # default (0 = governance off)
+    pool_bytes = None
+    if props.get("query.max-memory"):
+        from .memory import parse_data_size
+        pool_bytes = parse_data_size(props["query.max-memory"])
+
     co = Coordinator(port=port,
                      distributed=args.distributed,
                      catalogs=build_catalogs(args.etc_dir, plugins),
                      resource_groups=resource_groups,
                      authenticator=authenticator,
                      worker_uris=workers,
-                     spool_backend=spool_backend).start()
+                     spool_backend=spool_backend,
+                     memory_pool_bytes=pool_bytes).start()
     if workers and co.failure_detector is not None:
         # a configured fleet gets the active heartbeat loop on top of
         # the scheduler's task-failure feedback

@@ -15,13 +15,21 @@ from __future__ import annotations
 
 import re
 import threading
+import time
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Deque, Dict, List, Optional, Tuple
 
+from ..obs.metrics import QUERY_QUEUED_SECONDS, QUEUE_REJECTIONS
+
 
 class QueryQueueFullError(Exception):
-    """StandardErrorCode.QUERY_QUEUE_FULL (Appendix A.8)."""
+    """StandardErrorCode.QUERY_QUEUE_FULL (Appendix A.8).
+    ``error_name`` feeds errors.classify so the rejection reaches the
+    client with the Trino name + INSUFFICIENT_RESOURCES type instead
+    of a generic failure."""
+
+    error_name = "QUERY_QUEUE_FULL"
 
 
 @dataclass
@@ -31,12 +39,18 @@ class ResourceGroup:
     hard_concurrency: int = 100
     max_queued: int = 1000
     scheduling_weight: int = 1
+    # per-group memory budget for the cluster pool (server/memory.py):
+    # when the group's aggregate reservation exceeds it, the
+    # low-memory killer cancels the group's largest query. 0 = none.
+    soft_memory_limit_bytes: int = 0
     parent: Optional["ResourceGroup"] = None
     children: Dict[str, "ResourceGroup"] = field(default_factory=dict)
 
     # runtime state
     running: int = 0
-    _queue: Deque[Tuple[object, Callable[[], None]]] = \
+    # (tag, start_fn, enqueued_at) — the timestamp feeds the
+    # queued-time histogram at dequeue
+    _queue: Deque[Tuple[object, Callable[[], None], float]] = \
         field(default_factory=deque)
 
     @property
@@ -113,7 +127,9 @@ class ResourceGroupManager:
                 spec["name"],
                 hard_concurrency=spec.get("hardConcurrencyLimit", 100),
                 max_queued=spec.get("maxQueued", 1000),
-                scheduling_weight=spec.get("schedulingWeight", 1)))
+                scheduling_weight=spec.get("schedulingWeight", 1),
+                soft_memory_limit_bytes=int(
+                    spec.get("softMemoryLimitBytes", 0))))
             for sub in spec.get("subGroups", []):
                 build(sub, g)
 
@@ -152,22 +168,39 @@ class ResourceGroupManager:
                 group._start()
                 started = True
             elif group.queued() >= group.max_queued:
+                QUEUE_REJECTIONS.inc()
                 raise QueryQueueFullError(
                     f"Too many queued queries for "
                     f"\"{group.full_name}\"")
             else:
-                group._queue.append((tag, start_fn))
+                group._queue.append((tag, start_fn, time.monotonic()))
                 started = False
         if started:
             start_fn(group)
         return group, started
+
+    def remove_queued(self, tag: object) -> bool:
+        """Withdraw a still-queued query (deadline-killed or canceled
+        before admission). Without this a dead entry keeps consuming
+        ``max_queued`` capacity until some completion dequeues it —
+        and then burns a real concurrency slot starting a query that
+        will never run."""
+        with self._lock:
+            for g in self._walk(self.root):
+                for item in g._queue:
+                    if item[0] == tag:
+                        g._queue.remove(item)
+                        return True
+        return False
 
     def query_finished(self, group: ResourceGroup) -> None:
         to_start: List[Tuple[Callable, ResourceGroup]] = []
         with self._lock:
             group._finish_one()
             # weighted-fair pick among leaves with queued work, lowest
-            # running/weight first (WeightedFairQueue.java)
+            # running/weight first (WeightedFairQueue.java); within a
+            # leaf the queue drains FIFO — arrival order is the
+            # fairness contract queued clients observe
             while True:
                 candidates = [g for g in self._walk(self.root)
                               if g.queued() and g._can_run_more()]
@@ -176,7 +209,8 @@ class ResourceGroupManager:
                 g = min(candidates,
                         key=lambda x: x.running / max(
                             x.scheduling_weight, 1))
-                _, fn = g._queue.popleft()
+                _, fn, enq = g._queue.popleft()
+                QUERY_QUEUED_SECONDS.observe(time.monotonic() - enq)
                 g._start()
                 to_start.append((fn, g))
         for fn, g in to_start:
@@ -193,5 +227,6 @@ class ResourceGroupManager:
             return [{"name": g.full_name, "running": g.running,
                      "queued": g.queued(),
                      "hardConcurrencyLimit": g.hard_concurrency,
-                     "maxQueued": g.max_queued}
+                     "maxQueued": g.max_queued,
+                     "softMemoryLimitBytes": g.soft_memory_limit_bytes}
                     for g in self._walk(self.root)]

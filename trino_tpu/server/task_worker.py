@@ -43,6 +43,11 @@ _M_PAGE_BYTES = METRICS.counter(
 _M_TASKS = METRICS.counter(
     "trino_tpu_worker_tasks_total",
     "Tasks executed by this worker, by terminal state", ("state",))
+_M_TASKS_ABORTED = METRICS.counter(
+    "trino_tpu_worker_tasks_aborted_total",
+    "Tasks aborted by a coordinator DELETE while still tracked on "
+    "this worker: user cancels, deadline breaches, attempt timeouts, "
+    "and attempts superseded by a winning sibling")
 
 
 def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
@@ -119,6 +124,17 @@ class _Task:
                               schema=payload.get("schema"))
             for name, value in payload.get("properties", {}).items():
                 session.set(name, value)
+            # deadline propagation (server/coordinator.py -> exec/
+            # remote.py): the coordinator ships the REMAINING budget
+            # (relative seconds — wall clocks differ across hosts) and
+            # the worker re-derives an absolute deadline, so its own
+            # executor stops between plan nodes once the query's
+            # wall-clock budget is spent
+            rem = payload.get("deadline_s")
+            if rem is not None:
+                import time as _time
+                session.deadline = _time.monotonic() + max(
+                    float(rem), 0.0)
             # per-node stats + spans ride back in the task status (the
             # reference's TaskStatus/TaskStats carrying OperatorStats
             # to the coordinator for the stage rollup)
@@ -473,6 +489,10 @@ class TaskWorkerServer:
         if t is not None:
             t.state = "CANCELED"
             t.done.set()
+            # a coordinator-side stop (cancel, deadline breach, or a
+            # superseded attempt) reached THIS worker and ended a live
+            # task, observable in /metrics
+            _M_TASKS_ABORTED.inc()
 
     # -- membership ---------------------------------------------------
     def announce(self, coordinator_uri: str,
@@ -625,14 +645,18 @@ class RemoteTaskClient:
                         properties: Optional[dict] = None,
                         collect_stats: bool = False,
                         attempt: int = 0, spool: bool = False,
-                        stage: Optional[dict] = None):
+                        stage: Optional[dict] = None,
+                        deadline_s: Optional[float] = None):
         """POST a serialized plan fragment + split share (the
         HttpRemoteTask TaskUpdateRequest analog). ``attempt`` tags the
         task's retry/speculation generation; ``spool`` asks the worker
         to commit completed output pages to its spool. ``stage``
         carries the stage-DAG task context (trino_tpu/stage/): the
         stage id, the attempt-independent exchange key, the output
-        partition count, and the upstream exchange sources to pull."""
+        partition count, and the upstream exchange sources to pull.
+        ``deadline_s`` is the query's REMAINING wall-clock budget in
+        seconds (relative — host clocks differ); the worker re-derives
+        an absolute deadline for its executor."""
         body = {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
@@ -641,6 +665,8 @@ class RemoteTaskClient:
             "properties": properties or {}}
         if stage is not None:
             body["stage"] = stage
+        if deadline_s is not None:
+            body["deadline_s"] = float(deadline_s)
         return self._post(task_id, body)
 
     def status(self, task_id: str) -> dict:

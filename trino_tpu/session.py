@@ -45,8 +45,17 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # server/task_worker.py paginate)
     "exchange_compression": (bool, True),
     # wall-clock limit in seconds, 0 = unlimited (QUERY_MAX_RUN_TIME
-    # :72; enforced by the coordinator's query tracker)
+    # :72). The coordinator derives an ABSOLUTE per-query deadline
+    # (session.deadline) from it before dispatch; the executor checks
+    # it between plan nodes and the remote/stage schedulers bound every
+    # attempt, retry backoff, and speculation grant by the remaining
+    # budget — a breach cancels in-flight worker attempts instead of
+    # only failing the next coordinator poll (EXCEEDED_TIME_LIMIT)
     "query_max_run_time": (int, 0),
+    # cluster-wide per-query memory cap in bytes, 0 = pool-limit only
+    # (QUERY_MAX_MEMORY; enforced by server/memory.py when a cluster
+    # memory pool is configured — EXCEEDED_GLOBAL_MEMORY_LIMIT)
+    "query_max_memory": (int, 0),
     # cost-based join reorder/side decisions from connector statistics
     # (optimizer.use-table-statistics; planner/optimizer.py)
     "use_table_statistics": (bool, True),
@@ -125,6 +134,24 @@ class Session:
     # id of the query currently executing on this session (stamped by
     # the coordinator / runner; carried into events and spans)
     query_id: str = ""
+    # absolute per-query deadline (time.monotonic() timebase), derived
+    # from query_max_run_time by the coordinator's tracker (or by the
+    # standalone runner) — the executor and the remote/stage schedulers
+    # enforce it cooperatively (EXCEEDED_TIME_LIMIT on breach)
+    deadline: Optional[float] = None
+    # cluster memory governance (server/memory.py): a per-query
+    # reservation context; when set, Executor._reserve feeds its
+    # capacity estimates into the cluster pool, arming the per-group
+    # limits and the low-memory killer
+    memory: Optional[object] = None
+
+    def remaining_time(self) -> Optional[float]:
+        """Seconds left before the deadline (None = no deadline).
+        Negative once the budget is spent."""
+        if self.deadline is None:
+            return None
+        import time
+        return self.deadline - time.monotonic()
 
     def get(self, name: str):
         if name in self.properties:

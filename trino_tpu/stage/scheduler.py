@@ -143,6 +143,10 @@ class StageExecution:
     # -- the run -------------------------------------------------------
     def run(self) -> Dict[int, dict]:
         for stage in self.dag.stages:
+            # deadline propagation: no stage is dispatched past the
+            # query's wall-clock budget (the per-attempt waits below
+            # are bounded by the same shrinking remainder)
+            self.s._check_deadline(f"stage {stage.sid} dispatch")
             self._run_stage(stage)
         return self.sources
 
@@ -185,13 +189,15 @@ class StageExecution:
                     properties=dict(session.properties),
                     collect_stats=s.collect_stats,
                     attempt=attempt, spool=True,
+                    deadline_s=s._remaining_s(),
                     stage={"sid": sid, "exchange_key": st.key,
                            "nparts_out": nout,
                            "sources": stage_sources})
                 watch = _Watch(getattr(session, "cancel", None),
                                st.done)
-                status = client.wait_done(tid, cancel=watch,
-                                          timeout_s=timeout_s)
+                status = client.wait_done(
+                    tid, cancel=watch,
+                    timeout_s=s._attempt_budget_s(timeout_s))
                 if status.get("state") != "FINISHED":
                     raise RuntimeError(
                         f"task is {status.get('state')}: "
@@ -277,6 +283,9 @@ class StageExecution:
                 st.errors.append(err)
                 cancel = getattr(session, "cancel", None)
                 canceled = cancel is not None and cancel.is_set()
+                rem = s._remaining_s()
+                if rem is not None and rem <= 0:
+                    canceled = True     # deadline outranks the budget
                 if canceled or not self.controller.record_failure(
                         (sid, st.part)):
                     # out of attempts — but a healthy speculative
@@ -304,6 +313,8 @@ class StageExecution:
                                  error=err[-160:])
                 delay = backoff_delay(self.policy, failures,
                                       f"{self.qid}.s{sid}.{st.part}")
+                if rem is not None:
+                    delay = min(delay, max(rem, 0.0))
                 if st.done.wait(delay):
                     return    # a speculative sibling won during backoff
                 attempt = st.next_attempt()
@@ -336,6 +347,9 @@ class StageExecution:
                     elapsed = time.perf_counter() - t0
                     if not self.straggler.is_straggler(sid, elapsed):
                         continue
+                    rem = s._remaining_s()
+                    if rem is not None and rem <= 0:
+                        continue     # past the deadline: no new work
                     if not self.controller.grant_speculation(
                             (sid, st.part)):
                         continue
