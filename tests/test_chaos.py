@@ -396,7 +396,11 @@ def test_worker_announce_join_and_graceful_leave():
         assert w._announce_thread is not first_loop
         assert not w._announce_stop.is_set()     # new loop live
         listing = _get_json(co.base_uri + "/v1/announcement")
-        assert {"uri": w.base_uri, "alive": True} in listing["workers"]
+        mine = [e for e in listing["workers"]
+                if e["uri"] == w.base_uri]
+        # one entry, alive, carrying the PR 11 pre-warm readiness flag
+        assert len(mine) == 1 and mine[0]["alive"] is True
+        assert "prewarmed" in mine[0]
         # graceful leave rides on worker stop()
         w.stop()
         deadline = time.time() + 5

@@ -23,8 +23,19 @@ def test_property_registry_breadth():
                  "query_max_memory_per_node", "hash_partition_count",
                  "exchange_compression", "query_max_run_time",
                  "use_table_statistics", "pushdown_into_scan",
-                 "multistage_execution", "exchange_partition_count"):
+                 "multistage_execution", "exchange_partition_count",
+                 "prewarm_enabled", "hot_shape_top_k"):
         assert name in SESSION_PROPERTIES, name
+
+
+def test_prewarm_properties_defaults_and_types():
+    s = Session()
+    assert isinstance(s.get("prewarm_enabled"), bool)
+    assert int(s.get("hot_shape_top_k")) > 0
+    s.set("prewarm_enabled", "false")
+    assert s.get("prewarm_enabled") is False
+    s.set("hot_shape_top_k", "3")
+    assert s.get("hot_shape_top_k") == 3
 
 
 def test_multistage_execution_gates_the_stage_fragmenter():

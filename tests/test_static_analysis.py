@@ -474,6 +474,58 @@ def test_lint_flags_jit_impurities():
                if f.rule == "jit-closure-mutate")
 
 
+def test_lint_flags_aot_unsafe_branches():
+    """The aot-unsafe rule (PR 11): data-dependent Python control flow
+    inside traced functions — ``.item()`` host syncs and
+    int()/float()/bool() concretizations in branch conditions — can
+    never be lowered by the AOT path (exec/aot.py has no data to
+    branch on)."""
+    src = textwrap.dedent('''
+        import jax
+
+        @jax.jit
+        def f(x, y):
+            if x.item():
+                return y
+            while int(y) > 0:
+                y = y - 1
+            if bool(x) and float(y) > 0.5:
+                return x
+            if int(3) > 2:
+                return y          # constant arg: no data dependence
+            n = x.shape[0]        # static metadata: fine
+            return x + y
+
+        def not_traced(x):
+            if x.item():          # outside any traced function
+                return 1
+            return int(x)
+    ''')
+    findings = [f for f in lint_source(src, "a.py")
+                if f.rule == "aot-unsafe"]
+    lines = sorted(f.line for f in findings)
+    assert lines == [6, 8, 10, 10]
+    assert all(f.severity == "error" for f in findings)
+
+
+def test_lint_aot_unsafe_suppressible():
+    src = textwrap.dedent('''
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x.item():  # tt-lint: ignore[aot-unsafe] shape-gated constant under static_argnums
+                return x
+            return x
+    ''')
+    findings = lint_source(src, "a.py")
+    unsuppressed = [f for f in findings
+                    if f.rule == "aot-unsafe" and not f.suppressed]
+    assert not unsuppressed
+    assert any(f.rule == "aot-unsafe" and f.suppressed
+               for f in findings)
+
+
 def test_lint_shard_map_and_partial_decorator():
     src = textwrap.dedent('''
         import time

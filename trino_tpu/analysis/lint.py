@@ -47,6 +47,15 @@ them):
   (``results.append(x)`` where ``results`` is free) inside a traced
   function — executed per trace, not per call, which is almost never
   the intent.
+- ``aot-unsafe`` (error): data-dependent Python control flow inside a
+  traced function — ``.item()`` host syncs, and ``int(x)`` /
+  ``float(x)`` / ``bool(x)`` concretizations in ``if``/``while``
+  conditions. These already fail lazily at trace time with real data
+  (ConcretizationTypeError -> deny-list); on the AOT lower path
+  (exec/aot.py — ``jax.jit(fn).lower(avals).compile()`` against
+  shape-only avals) there is no data at all, so such a function can
+  never be pre-compiled. The rule keeps every cache-eligible program
+  AOT-lowerable.
 
 **Suppressions** — one line at a time, with a reason::
 
@@ -233,9 +242,13 @@ class _ModuleIndex(ast.NodeVisitor):
 # reservation bookkeeping run on dispatch threads (QueryTracker's
 # per-query threads call groups.query_finished and memory.reserve
 # concurrently), so their lock discipline must stay lint-reachable.
+# hotshapes joined in PR 11: the hot-shape registry is mutated by
+# query threads, task threads, and the worker pre-warm thread
+# concurrently (HOT_SHAPES.record/merge/export_since), so its lock
+# discipline must stay lint-reachable too.
 _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   "server/failure.py", "server/resourcegroups.py",
-                  "server/memory.py")
+                  "server/memory.py", "exec/hotshapes.py")
 
 
 class _CrossIndex:
@@ -537,6 +550,28 @@ class _JitAnalyzer:
     def _scan_traced(self, fn: ast.AST) -> None:
         local = _local_names(fn)
         for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While)):
+                # data-dependent Python branch: int(x)/float(x)/bool(x)
+                # in the condition concretizes a traced value — lazily
+                # a ConcretizationTypeError with real data, a hard
+                # impossibility on the AOT lower path (exec/aot.py
+                # compiles against shape-only avals: no data to
+                # branch on)
+                for sub in ast.walk(node.test):
+                    if isinstance(sub, ast.Call) \
+                            and isinstance(sub.func, ast.Name) \
+                            and sub.func.id in ("int", "float",
+                                                "bool") \
+                            and sub.args \
+                            and not isinstance(sub.args[0],
+                                               ast.Constant):
+                        self._emit(
+                            sub, "aot-unsafe", "error",
+                            f"'{sub.func.id}(...)' in a branch "
+                            "condition inside a traced function "
+                            "concretizes a traced value — "
+                            "data-dependent Python branches cannot "
+                            "be AOT-lowered")
             if isinstance(node, ast.Call):
                 d = _dotted(node.func)
                 if d is not None:
@@ -557,6 +592,16 @@ class _JitAnalyzer:
                                    f"'{d}' inside a jit/shard_map-"
                                    f"traced function: {why}")
                         continue
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item" \
+                        and not node.args:
+                    self._emit(
+                        node, "aot-unsafe", "error",
+                        f"'{_dotted(node.func) or 'item'}()' inside "
+                        "a traced function is a host sync — the AOT "
+                        "lower path has no data to sync, so the "
+                        "program cannot be compiled ahead of time")
+                    continue
                 if isinstance(node.func, ast.Attribute) \
                         and node.func.attr in _MUTATORS \
                         and isinstance(node.func.value, ast.Name) \

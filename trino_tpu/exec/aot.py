@@ -1,0 +1,165 @@
+"""Ahead-of-time fragment compilation: lower + compile without data.
+
+Reference parity: the paper's codegen layer maps to full AOT
+compilation of query programs (PAPERS: Julia-to-TPU, arxiv 1810.09868)
+over canonicalized operator-as-tensor-program shapes (arxiv
+2203.01877). The JVM reference needs nothing like this — bytecode
+generation is milliseconds — but XLA compile is 30-90s per fragment
+shape, so decoupling compilation from first execution is the
+difference between a worker that serves its first query at device
+speed and one that stalls a fleet.
+
+Mechanics: a hot-shape payload (exec/hotshapes.py) carries the
+CANONICAL fragment (exec/progkey.py wire form) plus the observed input
+lane spec at its capacity bucket. ``compile_entry`` rebuilds the exact
+closure the executor would build for that program, fabricates an
+argument Batch of ``jax.ShapeDtypeStruct`` avals — no real data — and
+runs ``jax.jit(fn).lower(batch).compile()``. The compile:
+
+- inserts the jitted callable into the in-process structural cache
+  under the SAME canonical key the executor probes
+  (``_CHAIN_JIT_CACHE`` / ``_STREAM_JIT_CACHE``), and
+- writes the compiled program into jax's persistent compilation cache
+  (config.py), so even a later signature variation (a different
+  capacity bucket, a fresh dictionary identity) pays only a re-trace,
+  never the XLA compile.
+
+AOT purity contract: functions lowered here must be data-independent
+Python — no ``if x.item()`` / ``int(arr)`` branches on traced values
+(there is no data to branch on). ``analysis/lint.py`` enforces this
+statically (the ``aot-unsafe`` rule)."""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..catalog import CatalogManager
+from ..obs.metrics import METRICS
+from ..session import Session
+
+_M_AOT = METRICS.counter(
+    "trino_tpu_aot_compiles_total",
+    "AOT fragment compilations by outcome",
+    ("kind", "result"))     # result: compiled | cached | error
+_M_AOT_WALL = METRICS.histogram(
+    "trino_tpu_aot_compile_seconds",
+    "Per-shape AOT compile wall (lower + XLA compile)",
+    ("kind",))
+
+
+def _aval_batch(payload: dict, schema):
+    """Fabricate the argument Batch: ShapeDtypeStruct lanes at the
+    recorded capacity bucket, real (small) dictionaries — everything
+    jax needs to trace and compile, nothing touching real data."""
+    import jax
+    from ..columnar import Batch, Column, StringDictionary
+    cap = int(payload["capacity"])
+    cols = {}
+    for ent in payload["cols"]:
+        name = ent["name"]
+        data = jax.ShapeDtypeStruct((cap,), np.dtype(ent["dtype"]))
+        valid = (jax.ShapeDtypeStruct((cap,), np.dtype(bool))
+                 if ent.get("valid") else None)
+        d2 = (jax.ShapeDtypeStruct((cap,), np.dtype(ent["data2"]))
+              if ent.get("data2") else None)
+        dictionary = None
+        if ent.get("dict") is not None:
+            dictionary = StringDictionary(
+                np.asarray(ent["dict"], dtype=object))
+        cols[name] = Column(schema[name], data, valid, dictionary, d2)
+    if payload["num_rows"] == "int":
+        num_rows = cap
+    else:
+        import jax as _jax
+        num_rows = _jax.ShapeDtypeStruct(
+            (), np.dtype(payload["num_rows"]))
+    return Batch(cols, num_rows)
+
+
+def compile_entry(entry: dict) -> Optional[float]:
+    """AOT-compile one hot-shape registry entry. Returns the compile
+    wall in seconds, or None when the program was already resident in
+    the in-process cache (a hit — nothing to do). Raises on a broken
+    payload; callers treat per-entry failures as skippable."""
+    import jax
+    from . import executor as ex
+    from .progkey import node_fingerprint, peel_wire_fragment
+    from ..plan.serde import from_jsonable
+
+    payload = entry["payload"] if "payload" in entry else entry
+    kind = str(payload["kind"])
+    root = from_jsonable(payload["fragment"])
+    nodes, schema = peel_wire_fragment(root)
+    fps = tuple(node_fingerprint(n) for n in nodes)
+    if any(f is None for f in fps):
+        raise ValueError("hot-shape fragment is not canonicalizable")
+
+    # the same helper shape the executor's structural closures capture:
+    # detached (no per-query state), catalogs untouched by chain
+    # evaluation
+    helper = ex.Executor(CatalogManager(), Session())
+
+    if kind == "chain":
+        key: object = fps
+        cache = ex._CHAIN_JIT_CACHE
+        chain = nodes
+
+        def fn(b):
+            for nd in reversed(chain):
+                b = helper._dispatch_apply(nd, b)
+            return b
+    elif kind in ("stream", "stream_full"):
+        # stream node stacks lead with the AggregationNode
+        # (progkey.canonicalize_nodes order)
+        agg, chain = nodes[0], nodes[1:]
+        run, run_full = ex.make_stream_runners(helper, chain, agg)
+        key = fps if kind == "stream" else (fps, "full")
+        cache = ex._STREAM_JIT_CACHE
+        fn = run if kind == "stream" else run_full
+    else:
+        raise ValueError(f"unknown hot-shape kind {kind!r}")
+
+    with ex._JIT_CACHE_LOCK:
+        resident = key in cache
+    if resident:
+        _M_AOT.inc(kind=kind, result="cached")
+        return None
+
+    t0 = time.perf_counter()
+    try:
+        jitted = jax.jit(fn)
+        jitted.lower(_aval_batch(payload, schema)).compile()
+    except Exception:
+        _M_AOT.inc(kind=kind, result="error")
+        raise
+    wall = time.perf_counter() - t0
+    # the jitted callable (now holding the compiled program in its own
+    # cache) lands under the executor's key: the first real query with
+    # this shape is an in-process cache hit
+    ex._cache_put(cache, key, jitted)
+    _M_AOT.inc(kind=kind, result="compiled")
+    _M_AOT_WALL.observe(wall, kind=kind)
+    return wall
+
+
+def compile_entries(entries: List[dict]) -> dict:
+    """Compile a hot-shape list (best-effort, per-entry isolation):
+    returns {"compiled": n, "cached": n, "errors": n, "wall_s": total}
+    — the pre-warm loop's summary (server/task_worker.py)."""
+    out = {"compiled": 0, "cached": 0, "errors": 0, "wall_s": 0.0}
+    for e in entries or ():
+        try:
+            wall = compile_entry(e)
+        except Exception:       # noqa: BLE001 — one bad shape must
+            # not abort the warm-up of the rest
+            out["errors"] += 1
+            continue
+        if wall is None:
+            out["cached"] += 1
+        else:
+            out["compiled"] += 1
+            out["wall_s"] += wall
+    return out

@@ -217,8 +217,8 @@ def stats_lines(stats: Sequence["NodeStats"]) -> List[str]:
 _TRACEABLE = ()  # filled after class definition
 _PPOS, _BPOS = "__probe_pos$", "__build_pos$"
 
-# cross-query caches of jitted plan programs, keyed by structural plan
-# fingerprint (_node_fingerprint); deny-lists for plans whose chains
+# cross-query caches of jitted plan programs, keyed by canonical
+# program key (exec/progkey.py); deny-lists for plans whose chains
 # touch host-only evaluation paths. Reference analog: the generated-
 # class caches of sql/gen/ExpressionCompiler.java (keyed on
 # RowExpression trees) — re-tracing an identical plan costs ~2s/query
@@ -255,56 +255,30 @@ from ..rex import VOLATILE_FNS as _VOLATILE_FNS, \
     expr_volatile as _expr_volatile
 
 
-def _node_fingerprint(nd) -> Optional[tuple]:
-    """Serialize every field a jitted evaluation of this node depends
-    on (row expressions are frozen dataclasses — repr() is total).
-    Returns None for node types outside the whitelist; callers fall
-    back to per-query identity keys. A collision between genuinely
-    different plans would reuse the wrong program, so any new field on
-    these nodes MUST be added here."""
-    if isinstance(nd, FilterNode):
-        if _expr_volatile(nd.predicate):
-            return None
-        return ("F", repr(nd.predicate))
-    if isinstance(nd, ProjectNode):
-        if any(_expr_volatile(e) for e in nd.assignments.values()):
-            return None
-        return ("P", tuple((s, repr(e))
-                           for s, e in nd.assignments.items()))
-    if isinstance(nd, SampleNode):
-        return ("S", nd.method, nd.ratio)
-    if isinstance(nd, LimitNode):
-        return ("L", nd.count, nd.partial)
-    if isinstance(nd, OffsetNode):
-        return ("O", nd.count)
-    if isinstance(nd, SortNode):
-        return ("So", nd.keys)
-    if isinstance(nd, TopNNode):
-        return ("T", nd.count, nd.keys, nd.step)
-    if isinstance(nd, AssignUniqueIdNode):
-        return ("U", nd.symbol)
-    if isinstance(nd, MarkDistinctNode):
-        return ("M", nd.marker, nd.keys)
-    if isinstance(nd, AggregationNode):
-        return ("A", tuple(nd.group_keys), nd.step, nd.group_id_symbol,
-                tuple((out, a.kind, a.argument, a.argument2, a.mask,
-                       a.distinct, a.param, repr(a.type))
-                      for out, a in nd.aggregates.items()))
-    return None
-
+# structural node fingerprints + the canonical program keys built on
+# them live in exec/progkey.py — ONE canonicalizer shared by the
+# in-process caches here, the hot-shape registry (exec/hotshapes.py),
+# and the AOT compiler (exec/aot.py)
 
 import threading as _jit_threading
 
 _JIT_CACHE_LOCK = _jit_threading.Lock()
+
+_M_JIT_EVICT = _METRICS.counter(
+    "trino_tpu_jit_cache_evictions_total",
+    "Structural jitted-program cache entries evicted at capacity "
+    "(TRINO_TPU_JIT_CACHE_ENTRIES)")
 
 
 def _cache_put(cache: Dict[tuple, object], key: tuple, val) -> None:
     # the coordinator runs one thread per query (server/coordinator.py)
     # — insert-with-eviction must not race another thread's eviction
     with _JIT_CACHE_LOCK:
-        while len(cache) >= 256:
+        limit = max(int(CONFIG.jit_cache_entries), 1)
+        while len(cache) >= limit:
             try:
                 cache.pop(next(iter(cache)))
+                _M_JIT_EVICT.inc()
             except (KeyError, StopIteration):
                 break
         cache[key] = val
@@ -347,8 +321,16 @@ class Executor:
         self.stats: List[NodeStats] = []
         if fragment_jit is None:
             # eager dispatch through the device tunnel is the bottleneck
-            # on TPU; on CPU the compile cost dominates short queries
-            fragment_jit = jax.default_backend() not in ("cpu",)
+            # on TPU; on CPU the compile cost dominates short queries.
+            # TRINO_TPU_FRAGMENT_JIT=1|0 overrides the backend default
+            # (a CPU fleet serving REPEATED shapes amortizes compiles
+            # through the canonical-key caches + persistent cache, and
+            # the warm-path tests exercise exactly that)
+            env = os.environ.get("TRINO_TPU_FRAGMENT_JIT", "")
+            if env in ("0", "1"):
+                fragment_jit = env == "1"
+            else:
+                fragment_jit = jax.default_backend() not in ("cpu",)
         self.fragment_jit = fragment_jit
         self._no_jit_chains: set = set()
         self._jit_chains: dict = {}
@@ -502,15 +484,21 @@ class Executor:
                 chain.append(cur)
                 cur = cur.source
             if chain:
-                fps = tuple(_node_fingerprint(n) for n in chain)
-                structural = all(f is not None for f in fps)
-                key = fps if structural else tuple(id(n) for n in chain)
+                # canonical program key (exec/progkey.py): renamed
+                # symbols and reordered columns land on ONE cached
+                # program; plans outside the canonical subset keep
+                # per-query identity keys
+                from .progkey import canonicalize_nodes
+                canon = canonicalize_nodes(chain)
+                structural = canon is not None
+                key = canon.key if structural \
+                    else tuple(id(n) for n in chain)
                 base = self.execute(cur)
                 if key not in self._no_jit_chains \
                         and key not in _CHAIN_JIT_DENY:
                     try:
                         return self._run_chain_jit(key, chain, base,
-                                                   structural)
+                                                   structural, canon)
                     except (jax.errors.TracerArrayConversionError,
                             jax.errors.ConcretizationTypeError):
                         # chain touches host-only paths (row-
@@ -540,18 +528,6 @@ class Executor:
     # then combining partials)
     # ------------------------------------------------------------------
     _STREAM_CHAIN = None   # set after class body
-
-    @staticmethod
-    def _stream_fingerprint(chain, node):
-        """Structural cache key for the streaming-aggregation program:
-        the chain nodes + the aggregation node (the input batch is a
-        jit argument — jax keys on its avals/treedef itself, so table
-        identity is irrelevant). None when any node isn't coverable."""
-        parts = [_node_fingerprint(nd) for nd in chain]
-        parts.append(_node_fingerprint(node))
-        if any(p is None for p in parts):
-            return None
-        return tuple(parts)
 
     _NONSTREAMABLE = {"min_by", "max_by", "approx_distinct",
                       "approx_percentile", "array_agg", "map_agg",
@@ -598,52 +574,34 @@ class Executor:
         phys = post = None
         helper = self._detached()   # closures below are cached
 
-        def partial(b: Batch):
-            # selection-vector execution: the filter chain becomes a
-            # live mask consumed by the aggregation (no compaction)
-            cols, live = helper._masked_chain_eval(chain, b)
-            src = Batch(cols, jnp.sum(live.astype(jnp.int64)))
-            _p, _post, extra = _lower_aggregates(node.aggregates, src)
-            if extra:
-                c2 = dict(src.columns)
-                c2.update(extra)
-                src = Batch(c2, src.num_rows)
-            if node.group_keys:
-                out = group_aggregate(src, list(node.group_keys), _p,
-                                      live=live)
-            else:
-                out = _pad_partial(global_aggregate(src, _p, live=live))
-            return out, _p, _post
+        # canonical program (exec/progkey.py): under fragment_jit the
+        # closures execute the CANONICAL node stack — renamed symbols /
+        # reordered columns across queries land on one cached program
+        # and one persistent-cache entry — with the input batches
+        # renamed through the plan's binding and the output renamed
+        # back once at the end. Plans outside the canonical subset
+        # keep the original nodes and a per-execution program.
+        canon = binding = None
+        node_x, chain_x = node, chain
+        if self.fragment_jit:
+            from .progkey import canonicalize_nodes
+            canon = canonicalize_nodes([node] + chain)
+            if canon is not None:
+                node_x, chain_x = canon.nodes[0], canon.nodes[1:]
+        fkey = canon.key if canon is not None else None
 
-        def run(b: Batch) -> Batch:
-            return partial(b)[0]
+        run, run_full = make_stream_runners(helper, chain_x, node_x)
 
-        fkey = (self._stream_fingerprint(chain, node)
-                if self.fragment_jit else None)
+        def bind(b: Batch) -> Batch:
+            nonlocal binding
+            if canon is None:
+                return b
+            if binding is None:
+                binding = canon.binding(b)
+            return binding.rename_in(b)
 
-        def run_full(b: Batch) -> Batch:
-            """Whole-table single program: partial aggregation + final
-            combine + post-processing (avg = sum/count etc.) fused into
-            one XLA computation — the shape of the hand-fused micro.
-            partial() lowers aggregates against the CHAIN OUTPUT
-            columns (projection-created symbols like checksum's arg
-            live there, not on the raw scan batch)."""
-            out, _p, _post = partial(b)
-            from ..ops.groupby import COMBINABLE_KINDS
-            fin = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
-                            a.output) for a in _p]
-            if node.group_keys:
-                out = group_aggregate(out, list(node.group_keys), fin)
-            else:
-                out = global_aggregate(out, fin)
-            if _post:
-                cols = dict(out.columns)
-                for sym, fn in _post.items():
-                    cols[sym] = fn(out)
-                keep = set(node.group_keys) | set(node.aggregates)
-                cols = {s: c for s, c in cols.items() if s in keep}
-                out = Batch(cols, out.num_rows)
-            return out
+        def unbind(b: Batch) -> Batch:
+            return b if binding is None else binding.rename_out(b)
 
         if raws is not None and len(raws) == 1 and self.fragment_jit:
             fullkey = None if fkey is None else (fkey, "full")
@@ -660,12 +618,17 @@ class Executor:
                     full_jit = jax.jit(run_full)
                     if fullkey is not None:
                         _cache_put(_STREAM_JIT_CACHE, fullkey, full_jit)
-                batch = Batch({sym: raws[0].column(col)
-                               for sym, col in cur.assignments.items()},
-                              raws[0].num_rows)
+                batch = bind(Batch(
+                    {sym: raws[0].column(col)
+                     for sym, col in cur.assignments.items()},
+                    raws[0].num_rows))
+                if fullkey is not None:
+                    from .hotshapes import record_program
+                    record_program("stream_full", fullkey, canon,
+                                   batch, self.session)
                 try:
-                    return self._jit_call(full_jit, (batch,), "stream",
-                                          full_hit)
+                    return unbind(self._jit_call(
+                        full_jit, (batch,), "stream", full_hit))
                 except (jax.errors.TracerArrayConversionError,
                         jax.errors.ConcretizationTypeError):
                     if fullkey is not None:
@@ -673,11 +636,13 @@ class Executor:
                         _STREAM_JIT_DENY.add(fullkey)
 
         # one jitted program serves every split (uniform capacities);
-        # the program is cached across QUERIES by plan fingerprint so a
-        # repeated query skips re-trace + executable reload (~2s/query
-        # through the persistent-cache path, measured on the tunnel)
+        # the program is cached across QUERIES by canonical program
+        # key so a repeated query skips re-trace + executable reload
+        # (~2s/query through the persistent-cache path, measured on
+        # the tunnel)
         run_jit = None
         jit_hit = False
+        recorded = False
         if self.fragment_jit:
             if fkey is not None and fkey not in _STREAM_JIT_DENY:
                 run_jit = _STREAM_JIT_CACHE.get(fkey)
@@ -691,11 +656,21 @@ class Executor:
         for raw in (raws if raws is not None else
                     (self._read_split(conn, sp, columns)
                      for sp in splits)):
-            batch = Batch({sym: raw.column(col)
-                           for sym, col in cur.assignments.items()},
-                          raw.num_rows)
+            batch = bind(Batch({sym: raw.column(col)
+                                for sym, col in cur.assignments.items()},
+                               raw.num_rows))
+            if fkey is not None and not recorded \
+                    and fkey not in _STREAM_JIT_DENY:
+                # deny-listed programs must not climb the pre-warm
+                # ranking: every joining worker would burn a top-K
+                # slot AOT-compiling a shape that cannot trace
+                from .hotshapes import record_program
+                record_program("stream", fkey, canon, batch,
+                               self.session)
+                recorded = True
             if phys is None:
-                phys, post, _ = _lower_aggregates(node.aggregates, batch)
+                phys, post, _ = _lower_aggregates(node_x.aggregates,
+                                                  batch)
             if run_jit is not None:
                 try:
                     out = self._jit_call(run_jit, (batch,), "stream",
@@ -715,18 +690,19 @@ class Executor:
         from ..ops.groupby import COMBINABLE_KINDS
         finals = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
                            a.output) for a in phys]
-        if node.group_keys:
-            out = group_aggregate(merged, list(node.group_keys), finals)
+        if node_x.group_keys:
+            out = group_aggregate(merged, list(node_x.group_keys),
+                                  finals)
         else:
             out = global_aggregate(merged, finals)
         if post:
             cols = dict(out.columns)
             for sym, fn in post.items():
                 cols[sym] = fn(out)
-            keep = set(node.group_keys) | set(node.aggregates)
+            keep = set(node_x.group_keys) | set(node_x.aggregates)
             cols = {s: c for s, c in cols.items() if s in keep}
             out = Batch(cols, out.num_rows)
-        return out
+        return unbind(out)
 
     # ------------------------------------------------------------------
     # masked (selection-vector) filter -> aggregation fusion: filters
@@ -830,21 +806,27 @@ class Executor:
             raise QueryError(str(e)) from e
 
     def _run_chain_jit(self, key, chain, base: Batch,
-                       structural: bool = False) -> Batch:
+                       structural: bool = False, canon=None) -> Batch:
         # cache the jitted callable per chain so repeated executions of
         # the same plan reuse the compiled XLA program (jax.jit's cache
         # is keyed on function identity). Structural keys live in a
         # module-level cache shared ACROSS queries; identity keys stay
         # per-executor (they can't outlive their plan objects safely).
+        # Structural programs execute the CANONICAL node stack with the
+        # input/output columns renamed through the plan's binding
+        # (exec/progkey.py) — the traced jaxpr is identical across
+        # renamed plans, so jax's persistent compilation cache is
+        # effectively keyed on the canonical program too.
         cache = _CHAIN_JIT_CACHE if structural else self._jit_chains
         jitted = cache.get(key)
         hit = jitted is not None
         _M_JIT.inc(cache="chain", result="hit" if hit else "miss")
         if jitted is None:
             helper = self._detached() if structural else self
+            nodes = canon.nodes if structural else chain
 
             def fn(b):
-                for nd in reversed(chain):
+                for nd in reversed(nodes):
                     b = helper._dispatch_apply(nd, b)
                 return b
             jitted = jax.jit(fn)
@@ -852,6 +834,13 @@ class Executor:
                 _cache_put(_CHAIN_JIT_CACHE, key, jitted)
             else:
                 cache[key] = jitted
+        if structural:
+            binding = canon.binding(base)
+            cb = binding.rename_in(base)
+            from .hotshapes import record_program
+            record_program("chain", key, canon, cb, self.session)
+            out = self._jit_call(jitted, (cb,), "chain", hit)
+            return binding.rename_out(out)
         return self._jit_call(jitted, (base,), "chain", hit)
 
     # ------------------------------------------------------------------
@@ -1502,6 +1491,59 @@ class Executor:
 
     def _single_row(self, src: Batch) -> Batch:
         return _single_row(src)
+
+
+def make_stream_runners(helper: "Executor", chain, node):
+    """Build the streaming-aggregation programs over a chain +
+    AggregationNode: ``run`` (per-split partial aggregation) and
+    ``run_full`` (whole-table partial + final combine + post-processing
+    fused into ONE XLA computation — the shape of the hand-fused
+    micro). Module-level so the AOT compiler (exec/aot.py) rebuilds
+    the EXACT closures the executor caches — a pre-warmed program and
+    a live query trace the same jaxpr."""
+
+    def partial(b: Batch):
+        # selection-vector execution: the filter chain becomes a
+        # live mask consumed by the aggregation (no compaction).
+        # Aggregates lower against the CHAIN OUTPUT columns
+        # (projection-created symbols like checksum's arg live there,
+        # not on the raw scan batch).
+        cols, live = helper._masked_chain_eval(chain, b)
+        src = Batch(cols, jnp.sum(live.astype(jnp.int64)))
+        _p, _post, extra = _lower_aggregates(node.aggregates, src)
+        if extra:
+            c2 = dict(src.columns)
+            c2.update(extra)
+            src = Batch(c2, src.num_rows)
+        if node.group_keys:
+            out = group_aggregate(src, list(node.group_keys), _p,
+                                  live=live)
+        else:
+            out = _pad_partial(global_aggregate(src, _p, live=live))
+        return out, _p, _post
+
+    def run(b: Batch) -> Batch:
+        return partial(b)[0]
+
+    def run_full(b: Batch) -> Batch:
+        out, _p, _post = partial(b)
+        from ..ops.groupby import COMBINABLE_KINDS
+        fin = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
+                        a.output) for a in _p]
+        if node.group_keys:
+            out = group_aggregate(out, list(node.group_keys), fin)
+        else:
+            out = global_aggregate(out, fin)
+        if _post:
+            cols = dict(out.columns)
+            for sym, fn in _post.items():
+                cols[sym] = fn(out)
+            keep = set(node.group_keys) | set(node.aggregates)
+            cols = {s: c for s, c in cols.items() if s in keep}
+            out = Batch(cols, out.num_rows)
+        return out
+
+    return run, run_full
 
 
 def setop_tag(lb: Batch, rb: Batch):

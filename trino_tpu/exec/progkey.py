@@ -1,0 +1,285 @@
+"""Canonical program keys: one shared canonicalizer for every
+compiled-program cache.
+
+Reference parity: the reference keys its generated-bytecode caches on
+RowExpression trees (sql/gen/ExpressionCompiler.java:56) — two queries
+whose expressions are structurally equal share one compiled class no
+matter what the analyzer named their symbols. Here the compiled unit
+is an XLA program and the cache has THREE layers that must agree on
+identity:
+
+1. the in-process structural caches (``exec/executor.py``
+   ``_CHAIN_JIT_CACHE`` / ``_STREAM_JIT_CACHE``),
+2. jax's own per-callable trace cache (keyed on the pytree treedef —
+   which includes Batch COLUMN NAMES and their order, columnar.py
+   ``_batch_flatten``),
+3. jax's persistent compilation cache on disk (config.py), keyed on
+   the serialized HLO.
+
+Plain structural fingerprints (the old ``_node_fingerprint`` keys)
+miss on all three layers whenever the planner renames a symbol
+(``l_quantity$3`` vs ``l_quantity$7`` for the same scan) or emits the
+same projection with a different column order — identical programs,
+full re-trace, full XLA recompile. This module fixes identity at the
+root: a traceable node chain is REWRITTEN over canonical symbol names
+(``c0, c1, ...`` in execution-order first use), producing
+
+- a canonical **key** (the fingerprint of the canonicalized nodes) for
+  the in-process caches and the hot-shape registry,
+- canonical **nodes** the cached closure actually executes, so the
+  traced jaxpr/HLO — and with it layers 2 and 3 — is byte-identical
+  across renamed plans (the persistent cache is thereby effectively
+  keyed on the canonical program too), and
+- a per-plan **binding** that renames input batch columns to canonical
+  names before the call and the output back after it.
+
+Capacity buckets are deliberately ABSENT from the key: jax
+specializes per input shape under one callable, and the power-of-two
+bucketing of config.capacity_for already collapses minor cardinality
+changes onto the same shapes. Constant literals are canonicalized to
+their typed planner values (``DATE '1998-09-02'`` and its int form
+key identically) but never erased — a constant is baked into the
+compiled program, so erasing it would alias genuinely different
+programs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace as dc_replace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..columnar import Batch
+from ..plan.nodes import (Aggregate, AggregationNode, AssignUniqueIdNode,
+                          FilterNode, LimitNode, MarkDistinctNode,
+                          OffsetNode, PlanNode, ProjectNode,
+                          RemoteSourceNode, SampleNode, SortKey,
+                          SortNode, TopNNode)
+from ..rex import (VOLATILE_FNS, Call, CaseExpr, Cast, Const, InputRef,
+                   Lambda, RowExpr)
+
+
+class _NotCanonical(Exception):
+    """Node/expression outside the canonicalizable subset (volatile
+    calls, unknown node kinds): callers fall back to identity keys."""
+
+
+class _SymbolMap:
+    """Deterministic symbol renaming: first use (in execution order)
+    wins ``c<i>``. The map is a bijection — two distinct source
+    symbols can never alias one canonical name."""
+
+    __slots__ = ("names",)
+
+    def __init__(self) -> None:
+        self.names: Dict[str, str] = {}
+
+    def sym(self, name: str) -> str:
+        got = self.names.get(name)
+        if got is None:
+            got = f"c{len(self.names)}"
+            self.names[name] = got
+        return got
+
+
+def _canon_expr(e: RowExpr, m: _SymbolMap) -> RowExpr:
+    if isinstance(e, InputRef):
+        return InputRef(m.sym(e.name), e.type)
+    if isinstance(e, Const):
+        return e
+    if isinstance(e, Call):
+        if e.fn in VOLATILE_FNS:
+            raise _NotCanonical(e.fn)
+        return Call(e.fn, tuple(_canon_expr(a, m) for a in e.args),
+                    e.type)
+    if isinstance(e, Cast):
+        return Cast(_canon_expr(e.arg, m), e.type, e.safe)
+    if isinstance(e, CaseExpr):
+        return CaseExpr(tuple((_canon_expr(c, m), _canon_expr(v, m))
+                              for c, v in e.whens),
+                        None if e.default is None
+                        else _canon_expr(e.default, m), e.type)
+    if isinstance(e, Lambda):
+        # lambda params are fresh symbols referenced via InputRef in
+        # the body — they rename through the same map
+        return Lambda(tuple(m.sym(p) for p in e.params),
+                      _canon_expr(e.body, m), e.type)
+    raise _NotCanonical(type(e).__name__)
+
+
+def _canon_aggregate(a: Aggregate, m: _SymbolMap) -> Aggregate:
+    return Aggregate(
+        a.kind,
+        None if a.argument is None else m.sym(a.argument),
+        a.type, a.distinct,
+        None if a.mask is None else m.sym(a.mask),
+        None if a.argument2 is None else m.sym(a.argument2),
+        a.param)
+
+
+def _canon_node(nd: PlanNode, m: _SymbolMap) -> PlanNode:
+    """Rebuild one chain node over canonical symbols (source link left
+    untouched — chain execution dispatches per node, never through
+    ``.source``)."""
+    if isinstance(nd, FilterNode):
+        return dc_replace(nd, predicate=_canon_expr(nd.predicate, m))
+    if isinstance(nd, ProjectNode):
+        # input symbols rename before output symbols: every InputRef of
+        # every assignment maps first, THEN the assignment targets —
+        # keeps pass-through projections (x -> x) idempotent
+        exprs = {s: _canon_expr(e, m) for s, e in nd.assignments.items()}
+        return dc_replace(nd, assignments={m.sym(s): e
+                                           for s, e in exprs.items()})
+    if isinstance(nd, (SampleNode, LimitNode, OffsetNode)):
+        return nd
+    if isinstance(nd, SortNode):
+        return dc_replace(nd, keys=tuple(
+            SortKey(m.sym(k.symbol), k.ascending, k.nulls_first)
+            for k in nd.keys))
+    if isinstance(nd, TopNNode):
+        return dc_replace(nd, keys=tuple(
+            SortKey(m.sym(k.symbol), k.ascending, k.nulls_first)
+            for k in nd.keys))
+    if isinstance(nd, AssignUniqueIdNode):
+        return dc_replace(nd, symbol=m.sym(nd.symbol))
+    if isinstance(nd, MarkDistinctNode):
+        return dc_replace(nd, keys=tuple(m.sym(k) for k in nd.keys),
+                          marker=m.sym(nd.marker))
+    if isinstance(nd, AggregationNode):
+        if nd.group_id_symbol is not None:
+            raise _NotCanonical("grouping-set aggregation")
+        return dc_replace(
+            nd,
+            group_keys=tuple(m.sym(k) for k in nd.group_keys),
+            aggregates={m.sym(out): _canon_aggregate(a, m)
+                        for out, a in nd.aggregates.items()})
+    raise _NotCanonical(type(nd).__name__)
+
+
+def node_fingerprint(nd: PlanNode) -> Optional[tuple]:
+    """Serialize every field a jitted evaluation of this node depends
+    on (row expressions are frozen dataclasses — repr() is total).
+    Returns None for node types outside the whitelist or volatile
+    expressions; callers fall back to per-query identity keys. A
+    collision between genuinely different plans would reuse the wrong
+    program, so any new field on these nodes MUST be added here."""
+    from ..rex import expr_volatile
+    if isinstance(nd, FilterNode):
+        if expr_volatile(nd.predicate):
+            return None
+        return ("F", repr(nd.predicate))
+    if isinstance(nd, ProjectNode):
+        if any(expr_volatile(e) for e in nd.assignments.values()):
+            return None
+        return ("P", tuple((s, repr(e))
+                           for s, e in nd.assignments.items()))
+    if isinstance(nd, SampleNode):
+        return ("S", nd.method, nd.ratio)
+    if isinstance(nd, LimitNode):
+        return ("L", nd.count, nd.partial)
+    if isinstance(nd, OffsetNode):
+        return ("O", nd.count)
+    if isinstance(nd, SortNode):
+        return ("So", nd.keys)
+    if isinstance(nd, TopNNode):
+        return ("T", nd.count, nd.keys, nd.step)
+    if isinstance(nd, AssignUniqueIdNode):
+        return ("U", nd.symbol)
+    if isinstance(nd, MarkDistinctNode):
+        return ("M", nd.marker, nd.keys)
+    if isinstance(nd, AggregationNode):
+        return ("A", tuple(nd.group_keys), nd.step, nd.group_id_symbol,
+                tuple((out, a.kind, a.argument, a.argument2, a.mask,
+                       a.distinct, a.param, repr(a.type))
+                      for out, a in nd.aggregates.items()))
+    return None
+
+
+class Binding:
+    """Per-plan rename shim around one canonical program: actual input
+    columns -> canonical names before the call, canonical output names
+    -> this plan's names after it. Columns the chain never references
+    (pass-through lanes under a filter) extend the map in sorted
+    original-name order — deterministic for a given input schema, so
+    every split of one scan binds identically."""
+
+    __slots__ = ("fwd", "inv")
+
+    def __init__(self, mapping: Dict[str, str],
+                 columns: Sequence[str]) -> None:
+        self.fwd = dict(mapping)
+        for name in sorted(c for c in columns if c not in self.fwd):
+            self.fwd[name] = f"x{len(self.fwd)}"
+        self.inv = {v: k for k, v in self.fwd.items()}
+
+    def rename_in(self, b: Batch) -> Batch:
+        cols = sorted(b.columns, key=lambda c: self.fwd[c])
+        return Batch({self.fwd[c]: b.columns[c] for c in cols},
+                     b.num_rows)
+
+    def rename_out(self, b: Batch) -> Batch:
+        return Batch({self.inv.get(s, s): c
+                      for s, c in b.columns.items()}, b.num_rows)
+
+
+class CanonicalProgram:
+    """A canonicalized traceable node stack (top-down order) + its
+    cache key and the plan's symbol map."""
+
+    __slots__ = ("key", "nodes", "mapping")
+
+    def __init__(self, key: tuple, nodes: List[PlanNode],
+                 mapping: Dict[str, str]) -> None:
+        self.key = key
+        self.nodes = nodes          # top-down, like the executor chain
+        self.mapping = mapping      # original symbol -> canonical
+
+    def binding(self, b: Batch) -> Binding:
+        return Binding(self.mapping, list(b.columns))
+
+    def wire_fragment(self, input_schema: Dict[str, object]) -> dict:
+        """Serialize the canonical stack as a plan fragment rooted in
+        its top node over a schema-carrying RemoteSourceNode leaf —
+        the hot-shape registry's transport form (plan/serde.py), which
+        a pre-warming worker decodes back into the exact closure the
+        executor would build (exec/aot.py)."""
+        from ..plan.serde import to_jsonable
+        body: PlanNode = RemoteSourceNode((), dict(input_schema),
+                                          "gather")
+        for nd in reversed(self.nodes):
+            body = dc_replace(nd, source=body)
+        return to_jsonable(body)
+
+
+def peel_wire_fragment(root: PlanNode) -> Tuple[List[PlanNode], Dict]:
+    """Inverse of ``wire_fragment``: (top-down node stack, input
+    schema) from a decoded fragment."""
+    nodes: List[PlanNode] = []
+    nd = root
+    while not isinstance(nd, RemoteSourceNode):
+        nodes.append(nd)
+        nd = nd.source
+    return nodes, dict(nd.schema)
+
+
+def canonicalize_nodes(nodes_top_down: Sequence[PlanNode]
+                       ) -> Optional[CanonicalProgram]:
+    """Canonicalize a traceable node stack (top-down, the executor's
+    chain order — for the streaming-aggregation program the
+    AggregationNode leads). Returns None when any node or expression
+    falls outside the canonical subset; callers keep per-query
+    identity keys for those."""
+    m = _SymbolMap()
+    canon: List[PlanNode] = []
+    try:
+        # execution order (bottom-up): input symbols take the low
+        # canonical indices, so the data-flow reading of c0.. matches
+        # what the program consumes first
+        for nd in reversed(list(nodes_top_down)):
+            canon.append(_canon_node(nd, m))
+    except _NotCanonical:
+        return None
+    canon.reverse()
+    fps = tuple(node_fingerprint(n) for n in canon)
+    if any(f is None for f in fps):
+        return None
+    return CanonicalProgram(fps, canon, dict(m.names))

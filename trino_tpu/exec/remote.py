@@ -718,6 +718,13 @@ class RemoteScheduler:
                 # query
                 if self.collect_stats:
                     status = client.status(tid)
+                    # the worker's compiled-shape delta feeds the
+                    # coordinator's hot-shape registry: DISPATCHED
+                    # fragments' programs become pre-warmable even
+                    # though the coordinator never compiled them
+                    # (exec/hotshapes.py)
+                    from .hotshapes import HOT_SHAPES
+                    HOT_SHAPES.merge(status.get("hotShapes") or [])
                     reported = [NodeStats.from_dict(d) for d in
                                 status.get("nodeStats") or []]
                     if reported:

@@ -470,6 +470,14 @@ class Coordinator:
         # (add_worker/remove_worker below), guarded by one lock.
         self.workers = [str(w).rstrip("/") for w in (worker_uris or [])]
         self._members_lock = threading.Lock()
+        # AOT pre-warm readiness per worker (announce payload flag,
+        # exec/hotshapes.py): live_workers() lists warm workers first
+        # so a fresh query's task fan-out prefers nodes that already
+        # compiled the hot shapes. Workers configured at boot are
+        # presumed warm-equivalent (they were part of the fleet the
+        # hot list was learned from).
+        self.worker_prewarmed: Dict[str, bool] = {
+            w: True for w in self.workers}
         # fault-tolerant execution (trino_tpu/fte/): one failure
         # detector and one spool shared by every query. The default
         # detector is feedback-driven (schedulers report observed task
@@ -624,19 +632,30 @@ class Coordinator:
     # ---- live worker membership --------------------------------------
     def live_workers(self) -> List[str]:
         """Current worker set minus nodes the failure detector reports
-        dead — the per-dispatch view the schedulers consume."""
+        dead — the per-dispatch view the schedulers consume. Pre-warmed
+        workers sort first (stable within each class), so a query's
+        initial task fan-out lands on nodes whose hot-shape programs
+        are already compiled; a scheduler's mid-query re-syncs are
+        append-only and unaffected (exec/remote.py _sync_workers)."""
         detector = self.failure_detector
         with self._members_lock:
             workers = list(self.workers)
-        return [w for w in workers
-                if detector is None or detector.is_alive(w)]
+            warm = dict(self.worker_prewarmed)
+        return sorted(
+            (w for w in workers
+             if detector is None or detector.is_alive(w)),
+            key=lambda w: not warm.get(w, False))
 
-    def add_worker(self, uri: str) -> bool:
+    def add_worker(self, uri: str,
+                   prewarmed: Optional[bool] = None) -> bool:
         """Join a worker at runtime (/v1/announcement POST; reference:
         DiscoveryNodeManager absorbing a service announcement). A
         joining worker immediately becomes a retry / speculation
         target for in-flight queries and a full member for new ones.
-        Idempotent: re-announcement of a known worker is a no-op."""
+        Idempotent: re-announcement of a known worker is a no-op for
+        membership but still refreshes its pre-warm readiness flag —
+        that is how a joiner's background warm-up completion reaches
+        the scheduler (the worker re-announces with prewarmed=true)."""
         uri = str(uri).rstrip("/")
         if not uri:
             return False
@@ -645,6 +664,8 @@ class Coordinator:
             # runs under the lock: concurrent first announcements must
             # not construct two detectors (a worker registered in the
             # discarded one would never be heartbeat-probed)
+            if prewarmed is not None:
+                self.worker_prewarmed[uri] = bool(prewarmed)
             if uri in self.workers:
                 return False
             self.workers.append(uri)
@@ -673,6 +694,7 @@ class Coordinator:
         retry engine routes around (PR 5)."""
         uri = str(uri).rstrip("/")
         with self._members_lock:
+            self.worker_prewarmed.pop(uri, None)
             if uri not in self.workers:
                 return False
             self.workers.remove(uri)
@@ -1137,16 +1159,20 @@ def _make_handler(co: Coordinator):
                 # worker join (discovery-service announcement analog);
                 # idempotent, so workers re-announce on a cadence
                 n = int(self.headers.get("Content-Length", 0))
+                prewarmed = None
                 try:
                     body = json.loads(self.rfile.read(n) or b"{}")
                     uri = str(body.get("uri", "")).strip() \
                         if isinstance(body, dict) else ""
+                    if isinstance(body, dict) \
+                            and "prewarmed" in body:
+                        prewarmed = bool(body.get("prewarmed"))
                 except (ValueError, TypeError):
                     uri = ""
                 if not uri:
                     self._send(400, {"error": "missing worker uri"})
                     return
-                joined = co.add_worker(uri)
+                joined = co.add_worker(uri, prewarmed=prewarmed)
                 self._send(200, {"joined": joined,
                                  "workers": co.live_workers()})
                 return
@@ -1193,8 +1219,29 @@ def _make_handler(co: Coordinator):
                 self._send(200, {"workers": [
                     {"uri": w,
                      "alive": (detector is None
-                               or detector.is_alive(w))}
+                               or detector.is_alive(w)),
+                     "prewarmed": co.worker_prewarmed.get(w, False)}
                     for w in list(co.workers)]})
+                return
+            if path == "/v1/hotshapes":
+                # the worker pre-warm feed (exec/hotshapes.py): the
+                # top-k hottest compiled-program shapes this
+                # coordinator has seen, ranked by hit count then
+                # recency. ?k= bounds the list; default is the
+                # hot_shape_top_k session default — the same K a
+                # joining worker compiles before taking traffic.
+                from urllib.parse import parse_qs
+                from ..exec.hotshapes import HOT_SHAPES
+                from ..session import SESSION_PROPERTIES
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    k = int((q.get("k") or [0])[0])
+                except ValueError:
+                    k = 0
+                if k <= 0:
+                    k = int(SESSION_PROPERTIES["hot_shape_top_k"][1])
+                self._send(200, {"shapes": HOT_SHAPES.top(k),
+                                 "tracked": len(HOT_SHAPES)})
                 return
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 q = co.tracker.get(parts[2])

@@ -92,6 +92,13 @@ def main(argv=None) -> int:
                          "authenticates requests. Also discovery.token "
                          "in config.properties / env "
                          "TRINO_TPU_COORDINATOR_TOKEN")
+    ap.add_argument("--prewarm-top-k", type=int, default=None,
+                    help="[worker role] how many of the coordinator's "
+                         "hot shapes to AOT-compile before advertising "
+                         "this worker warm (GET /v1/hotshapes; default "
+                         "env TRINO_TPU_PREWARM_TOP_K; pre-warm "
+                         "disabled entirely via TRINO_TPU_PREWARM=0 or "
+                         "prewarm.enabled=false)")
     ap.add_argument("--spool-backend", default=None,
                     help="fault-tolerance spool backend: 'local' "
                          "(directory tree) or 'memory' (object-store "
@@ -203,10 +210,20 @@ def _worker_main(args, props: Dict[str, str], port: int) -> int:
     token = (args.coordinator_token or props.get("discovery.token")
              or os.environ.get("TRINO_TPU_COORDINATOR_TOKEN") or None)
     if coordinator_uri:
-        joined = srv.announce(coordinator_uri, token=token)
+        from ..config import CONFIG
+        prewarm = CONFIG.prewarm_enabled
+        if props.get("prewarm.enabled", "").lower() in ("false", "0"):
+            prewarm = False
+        top_k = args.prewarm_top_k
+        if top_k is None and props.get("prewarm.top-k"):
+            top_k = int(props["prewarm.top-k"])
+        joined = srv.announce(coordinator_uri, token=token,
+                              prewarm=prewarm,
+                              prewarm_top_k=top_k)
         print(f"trino-tpu worker {srv.node_id} on {srv.base_uri} "
               f"({'joined' if joined else 'announcing to'} "
-              f"{coordinator_uri})")
+              f"{coordinator_uri}"
+              + (", pre-warming hot shapes" if prewarm else "") + ")")
     else:
         print(f"trino-tpu worker {srv.node_id} on {srv.base_uri} "
               "(standalone: pass --coordinator-uri to join a cluster)")

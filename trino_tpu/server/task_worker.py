@@ -112,11 +112,18 @@ class _Task:
         self.pages: List[bytes] = []
         self.node_stats: List[dict] = []   # NodeStats.to_dict per node
         self.spans: List[dict] = []        # worker-local span tree
+        # structural program shapes this task's execution recorded
+        # (exec/hotshapes.py delta): ride back in the task status so
+        # the coordinator's registry covers every DISPATCHED
+        # fragment's shapes, not only its own combine programs
+        self.hot_shapes: List[dict] = []
         self.peak_memory_bytes = 0
         self.spill_bytes = 0
         self.done = threading.Event()
 
     def run(self, payload: dict):
+        from ..exec.hotshapes import HOT_SHAPES
+        shapes_before = HOT_SHAPES.hit_counts()
         try:
             from ..runner import LocalQueryRunner
             from ..session import Session
@@ -240,6 +247,15 @@ class _Task:
             self.state = "FAILED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
             self.error = f"{type(e).__name__}: {e}"  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
         finally:
+            try:
+                # hit-count DELTAS since this task started: concurrent
+                # tasks may each claim a shared sighting (their deltas
+                # overlap), which can only over-report by the overlap —
+                # never multiply cumulative counts per status the way a
+                # raw export would
+                self.hot_shapes = HOT_SHAPES.export_delta(shapes_before)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+            except Exception:    # noqa: BLE001
+                pass
             _M_TASKS.inc(state=self.state)
             self.done.set()
 
@@ -419,6 +435,7 @@ class TaskWorkerServer:
                          "error": t.error,
                          "nodeStats": t.node_stats,
                          "spans": t.spans,
+                         "hotShapes": t.hot_shapes,
                          "peakMemoryBytes": t.peak_memory_bytes,
                          "spillBytes": t.spill_bytes}).encode()
                     self.send_response(200)
@@ -455,6 +472,22 @@ class TaskWorkerServer:
         self._announced_to: Optional[str] = None
         self._announce_token: Optional[str] = None
         self._announce_thread: Optional[threading.Thread] = None
+        # serializes every announce beat with stop()'s graceful leave:
+        # a beat that already passed its stop check must finish BEFORE
+        # the leave is sent, or the late announce would resurrect the
+        # registration the coordinator just removed (the worker never
+        # re-leaves — a phantom member until the failure detector
+        # notices)
+        self._announce_lock = threading.Lock()
+        # AOT pre-warm state (exec/aot.py): a joining worker pulls the
+        # coordinator's hot-shape list and compiles the top-K on a
+        # background thread; ``prewarm_ready`` rides every announce
+        # payload so the scheduler can prefer warm workers. The lock
+        # guards the flag against the announce loop reading it while
+        # the prewarm thread flips it.
+        self._prewarm_lock = threading.Lock()
+        self.prewarm_ready = False
+        self._prewarm_summary: Optional[dict] = None
         import uuid as _uuid
         self.node_id = f"worker-{_uuid.uuid4().hex[:8]}"
 
@@ -495,39 +528,112 @@ class TaskWorkerServer:
             _M_TASKS_ABORTED.inc()
 
     # -- membership ---------------------------------------------------
+    def _is_prewarmed(self) -> bool:
+        with self._prewarm_lock:
+            return self.prewarm_ready
+
+    def prewarm_from(self, coordinator_uri: str,
+                     top_k: Optional[int] = None,
+                     token: Optional[str] = None) -> dict:
+        """Pull the coordinator's hot-shape list and AOT-compile it
+        (exec/aot.py) — the announce-loop hook that turns a cold
+        joiner warm BEFORE its first fragment arrives. Sets
+        ``prewarm_ready`` even when the list is empty or a shape
+        fails: readiness means "the warm-up ran", not "every shape
+        compiled" (a coordinator with no history must not leave its
+        whole fleet permanently cold-flagged)."""
+        from ..config import CONFIG
+        from ..exec import aot
+        k = CONFIG.prewarm_top_k if top_k is None else int(top_k)
+        shapes = []
+        try:
+            req = urllib.request.Request(
+                f"{coordinator_uri.rstrip('/')}/v1/hotshapes?k={k}")
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            with urllib.request.urlopen(req, timeout=10) as r:
+                shapes = json.loads(r.read()).get("shapes") or []
+        except Exception:       # noqa: BLE001 — an unreachable/older
+            # coordinator yields an empty warm-up, not a dead worker
+            shapes = []
+        summary = aot.compile_entries(shapes)
+        summary["pulled"] = len(shapes)
+        with self._prewarm_lock:
+            self.prewarm_ready = True
+            self._prewarm_summary = summary
+        return summary
+
     def announce(self, coordinator_uri: str,
                  interval_s: float = 10.0,
-                 token: Optional[str] = None) -> bool:
+                 token: Optional[str] = None,
+                 prewarm: Optional[bool] = None,
+                 prewarm_top_k: Optional[int] = None) -> bool:
         """Join ``coordinator_uri``'s worker set now, then keep
         re-announcing on a daemon thread (registration survives a
         coordinator restart: the fresh coordinator learns this worker
         at the next beat). ``token`` rides as a Bearer credential on
         every announce/leave — required when the coordinator runs an
         authenticator, whose gate sits in front of /v1/announcement
-        like every other resource. Returns whether the first announce
-        landed. Safe to call repeatedly (e.g. re-pointing the worker
-        at a new coordinator, or after stop()): each call retires the
-        previous announcer loop via its own stop event, so exactly one
-        loop ever beats."""
+        like every other resource. ``prewarm`` (default: config
+        TRINO_TPU_PREWARM) starts the hot-shape warm-up on a
+        background thread after the first announce; the readiness
+        flag rides every announce payload, and the moment warm-up
+        finishes an extra beat pushes it to the coordinator so the
+        scheduler prefers this worker without waiting out the
+        interval. Returns whether the first announce landed. Safe to
+        call repeatedly (e.g. re-pointing the worker at a new
+        coordinator, or after stop()): each call retires the previous
+        announcer loop via its own stop event, so exactly one loop
+        ever beats."""
+        from ..config import CONFIG
         self._announce_stop.set()       # retire any previous announcer
         stop = self._announce_stop = threading.Event()
         self._announced_to = coordinator_uri.rstrip("/")
         self._announce_token = token
         ok = announce_once(self._announced_to, self.base_uri,
-                           self.node_id, token=token)
+                           self.node_id, token=token,
+                           prewarmed=self._is_prewarmed())
 
         def loop():
             while not stop.wait(interval_s):
                 try:
-                    announce_once(self._announced_to, self.base_uri,
-                                  self.node_id,
-                                  token=self._announce_token)
+                    with self._announce_lock:
+                        if stop.is_set():
+                            return      # stop() won: no beat after it
+                        announce_once(self._announced_to,
+                                      self.base_uri, self.node_id,
+                                      token=self._announce_token,
+                                      prewarmed=self._is_prewarmed())
                 except Exception:       # noqa: BLE001 — next beat
                     pass
 
         self._announce_thread = threading.Thread(target=loop,
                                                  daemon=True)
         self._announce_thread.start()
+
+        if prewarm is None:
+            prewarm = CONFIG.prewarm_enabled
+        if prewarm and not self._is_prewarmed():
+            uri, tok = self._announced_to, token
+
+            def warmup():
+                try:
+                    self.prewarm_from(uri, top_k=prewarm_top_k,
+                                      token=tok)
+                except Exception:       # noqa: BLE001 — a failed
+                    # warm-up leaves the worker cold-flagged but
+                    # fully serving
+                    return
+                try:            # readiness beat, ahead of the cadence
+                    with self._announce_lock:
+                        if not stop.is_set():
+                            announce_once(uri, self.base_uri,
+                                          self.node_id, token=tok,
+                                          prewarmed=True)
+                except Exception:       # noqa: BLE001
+                    pass
+
+            threading.Thread(target=warmup, daemon=True).start()
         return ok
 
     # -- lifecycle ----------------------------------------------------
@@ -538,33 +644,42 @@ class TaskWorkerServer:
         return self
 
     def stop(self):
-        self._announce_stop.set()
-        if self._announced_to:
-            try:      # graceful leave; the heartbeat detector is the
-                #       backstop for ungraceful deaths
-                req = urllib.request.Request(
-                    f"{self._announced_to}/v1/announcement"
-                    f"?uri={self.base_uri}", method="DELETE")
-                if self._announce_token:
-                    req.add_header("Authorization",
-                                   f"Bearer {self._announce_token}")
-                with urllib.request.urlopen(req, timeout=5):
+        # stop-then-leave under the announce lock: an in-flight beat
+        # finishes first, and no beat can start after the stop event is
+        # set — the leave is guaranteed to be the LAST membership write
+        # this worker sends
+        with self._announce_lock:
+            self._announce_stop.set()
+            if self._announced_to:
+                try:  # graceful leave; the heartbeat detector is the
+                    #   backstop for ungraceful deaths
+                    req = urllib.request.Request(
+                        f"{self._announced_to}/v1/announcement"
+                        f"?uri={self.base_uri}", method="DELETE")
+                    if self._announce_token:
+                        req.add_header(
+                            "Authorization",
+                            f"Bearer {self._announce_token}")
+                    with urllib.request.urlopen(req, timeout=5):
+                        pass
+                except Exception:       # noqa: BLE001
                     pass
-            except Exception:           # noqa: BLE001
-                pass
         self._httpd.shutdown()
         self._httpd.server_close()
 
 
 def announce_once(coordinator_uri: str, worker_uri: str,
                   node_id: Optional[str] = None,
-                  token: Optional[str] = None) -> bool:
+                  token: Optional[str] = None,
+                  prewarmed: bool = False) -> bool:
     """One worker-join announcement (POST /v1/announcement on the
     coordinator — the discovery-service registration analog).
     ``token`` is the Bearer credential for authenticated
-    coordinators."""
+    coordinators; ``prewarmed`` is the AOT warm-up readiness flag the
+    scheduler's warm-worker preference keys on."""
     payload = json.dumps({"uri": worker_uri,
-                          "nodeId": node_id or worker_uri}).encode()
+                          "nodeId": node_id or worker_uri,
+                          "prewarmed": bool(prewarmed)}).encode()
     headers = {"Content-Type": "application/json"}
     if token:
         headers["Authorization"] = f"Bearer {token}"

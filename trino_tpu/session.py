@@ -109,6 +109,18 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # hash_partition_count — reference: SystemSessionProperties
     # FAULT_TOLERANT_EXECUTION_PARTITION_COUNT)
     "exchange_partition_count": (int, 0),
+    # ---- compile amortization (exec/progkey.py + exec/hotshapes.py +
+    # exec/aot.py) ----------------------------------------------------
+    # record this query's structural program shapes into the hot-shape
+    # registry (the worker pre-warm feed): off = the query still HITS
+    # warm caches but contributes nothing to them (e.g. exploratory
+    # one-off SQL that must not evict the fleet's hot shapes)
+    "prewarm_enabled": (bool, CONFIG.prewarm_enabled),
+    # per-query budget of NEW registry entries (a generated-SQL storm
+    # of one-off shapes keeps hitting existing entries but cannot
+    # flood the feed); also the default count served at /v1/hotshapes
+    # when the puller names no k
+    "hot_shape_top_k": (int, CONFIG.prewarm_top_k),
 }
 
 

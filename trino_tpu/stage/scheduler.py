@@ -236,6 +236,12 @@ class StageExecution:
             # the winner MUST set st.done (finally): a crash in the
             # best-effort telemetry would strand the untimed stage wait
             try:
+                # stage tasks report their compiled-shape deltas in
+                # the status the scheduler already polls — merged here
+                # so the coordinator's hot-shape registry covers
+                # worker-side joins/aggregations too (exec/hotshapes)
+                from ..exec.hotshapes import HOT_SHAPES
+                HOT_SHAPES.merge(status.get("hotShapes") or [])
                 if speculative:
                     with s._stats_lock:
                         s.speculative_wins += 1
