@@ -260,6 +260,11 @@ def test_real_executor_feeds_pool_and_dies_with_trino_error():
     # — a 512 KiB pool guarantees the breach
     memory = ClusterMemoryManager(ClusterMemoryPool(1 << 19))
     s = Session(catalog="tpch", schema="tiny")
+    # pin the MATERIALIZED path: with morsel streaming engaged this
+    # query now legitimately completes under the pool by reserving
+    # its streamed peak (tests/test_stream_exec.py proves that); this
+    # test's subject is the un-streamed wiring + killer identity
+    s.set("stream_chunk_rows", -1)
     s.memory = memory.register("qx", kill_fn=lambda m, n: None)
     lr = LocalQueryRunner(session=s)
     with pytest.raises(QueryError) as exc:

@@ -24,8 +24,18 @@ def test_property_registry_breadth():
                  "exchange_compression", "query_max_run_time",
                  "use_table_statistics", "pushdown_into_scan",
                  "multistage_execution", "exchange_partition_count",
-                 "prewarm_enabled", "hot_shape_top_k"):
+                 "prewarm_enabled", "hot_shape_top_k",
+                 "stream_chunk_rows"):
         assert name in SESSION_PROPERTIES, name
+
+
+def test_stream_chunk_rows_defaults_and_types():
+    s = Session()
+    assert int(s.get("stream_chunk_rows")) == 0   # auto-engage
+    s.set("stream_chunk_rows", "4096")
+    assert s.get("stream_chunk_rows") == 4096
+    s.set("stream_chunk_rows", -1)                # disabled
+    assert s.get("stream_chunk_rows") == -1
 
 
 def test_prewarm_properties_defaults_and_types():

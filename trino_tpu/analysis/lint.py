@@ -245,10 +245,13 @@ class _ModuleIndex(ast.NodeVisitor):
 # hotshapes joined in PR 11: the hot-shape registry is mutated by
 # query threads, task threads, and the worker pre-warm thread
 # concurrently (HOT_SHAPES.record/merge/export_since), so its lock
-# discipline must stay lint-reachable too.
+# discipline must stay lint-reachable too. streamjoin joined in PR 12:
+# its jitted-program caches are mutated by query threads and the
+# worker pre-warm thread (exec/aot.py streamjoin entries).
 _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   "server/failure.py", "server/resourcegroups.py",
-                  "server/memory.py", "exec/hotshapes.py")
+                  "server/memory.py", "exec/hotshapes.py",
+                  "exec/streamjoin.py")
 
 
 class _CrossIndex:

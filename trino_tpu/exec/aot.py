@@ -91,36 +91,48 @@ def compile_entry(entry: dict) -> Optional[float]:
 
     payload = entry["payload"] if "payload" in entry else entry
     kind = str(payload["kind"])
-    root = from_jsonable(payload["fragment"])
-    nodes, schema = peel_wire_fragment(root)
-    fps = tuple(node_fingerprint(n) for n in nodes)
-    if any(f is None for f in fps):
-        raise ValueError("hot-shape fragment is not canonicalizable")
-
-    # the same helper shape the executor's structural closures capture:
-    # detached (no per-query state), catalogs untouched by chain
-    # evaluation
-    helper = ex.Executor(CatalogManager(), Session())
-
-    if kind == "chain":
-        key: object = fps
-        cache = ex._CHAIN_JIT_CACHE
-        chain = nodes
-
-        def fn(b):
-            for nd in reversed(chain):
-                b = helper._dispatch_apply(nd, b)
-            return b
-    elif kind in ("stream", "stream_full"):
-        # stream node stacks lead with the AggregationNode
-        # (progkey.canonicalize_nodes order)
-        agg, chain = nodes[0], nodes[1:]
-        run, run_full = ex.make_stream_runners(helper, chain, agg)
-        key = fps if kind == "stream" else (fps, "full")
-        cache = ex._STREAM_JIT_CACHE
-        fn = run if kind == "stream" else run_full
+    if kind == "streamjoin":
+        # streamed-join probe programs (exec/streamjoin.py) carry
+        # their own transport form: a JoinNode over two schema-
+        # carrying RemoteSource leaves + both sides' lane specs, so a
+        # pre-warming worker compiles the chunk kernel at its
+        # canonical chunk capacity too
+        from .streamjoin import _JOIN_JIT_CACHE, aot_entry
+        key, fn, args = aot_entry(payload)
+        cache = _JOIN_JIT_CACHE
     else:
-        raise ValueError(f"unknown hot-shape kind {kind!r}")
+        root = from_jsonable(payload["fragment"])
+        nodes, schema = peel_wire_fragment(root)
+        fps = tuple(node_fingerprint(n) for n in nodes)
+        if any(f is None for f in fps):
+            raise ValueError("hot-shape fragment is not "
+                             "canonicalizable")
+
+        # the same helper shape the executor's structural closures
+        # capture: detached (no per-query state), catalogs untouched
+        # by chain evaluation
+        helper = ex.Executor(CatalogManager(), Session())
+
+        if kind == "chain":
+            key = fps
+            cache = ex._CHAIN_JIT_CACHE
+            chain = nodes
+
+            def fn(b):
+                for nd in reversed(chain):
+                    b = helper._dispatch_apply(nd, b)
+                return b
+        elif kind in ("stream", "stream_full"):
+            # stream node stacks lead with the AggregationNode
+            # (progkey.canonicalize_nodes order)
+            agg, chain = nodes[0], nodes[1:]
+            run, run_full = ex.make_stream_runners(helper, chain, agg)
+            key = fps if kind == "stream" else (fps, "full")
+            cache = ex._STREAM_JIT_CACHE
+            fn = run if kind == "stream" else run_full
+        else:
+            raise ValueError(f"unknown hot-shape kind {kind!r}")
+        args = (_aval_batch(payload, schema),)
 
     with ex._JIT_CACHE_LOCK:
         resident = key in cache
@@ -131,7 +143,7 @@ def compile_entry(entry: dict) -> Optional[float]:
     t0 = time.perf_counter()
     try:
         jitted = jax.jit(fn)
-        jitted.lower(_aval_batch(payload, schema)).compile()
+        jitted.lower(*args).compile()
     except Exception:
         _M_AOT.inc(kind=kind, result="error")
         raise

@@ -340,6 +340,12 @@ class Executor:
         self._frames: List[dict] = []
         self.peak_reserved_bytes: int = 0
         self.spilled_bytes: int = 0
+        # morsel streaming (exec/streamjoin.py): chunks processed and
+        # host->device bytes moved by streamed operators this query —
+        # exported in worker task status (streamChunks/streamH2dBytes)
+        # and rolled up by the remote/stage schedulers
+        self.stream_chunks: int = 0
+        self.stream_h2d_bytes: int = 0
         # remote-task split addressing: (part, nparts) makes every scan
         # read only splits with index % nparts == part (the worker's
         # share of a fragment — server/task_worker.py fragment payloads;
@@ -404,8 +410,15 @@ class Executor:
         if not name.startswith("_"):
             # internal wrappers (_Pre preloaded batches) are plumbing,
             # not operators — they feed the parent's input, no entry
+            detail = ""
+            if frame.get("stream_chunks"):
+                # morsel streaming: chunk count + transfer volume per
+                # operator, the EXPLAIN ANALYZE face of streamjoin.py
+                detail = (f"streamed {frame['stream_chunks']} chunks, "
+                          f"{frame.get('stream_h2d', 0)}B h2d")
             self.stats.append(NodeStats(
-                name, wall_s=time.perf_counter() - t0, output_rows=n,
+                name, detail, wall_s=time.perf_counter() - t0,
+                output_rows=n,
                 input_rows=frame["rows"], input_bytes=frame["bytes"],
                 output_bytes=obytes, compile_s=frame["compile_s"],
                 cache_hit=frame["cache"]))
@@ -464,6 +477,16 @@ class Executor:
         return b
 
     def _execute_inner(self, node: PlanNode) -> Batch:
+        if isinstance(node, (FilterNode, ProjectNode)):
+            # beyond-HBM morsel streaming (exec/streamjoin.py): a
+            # Filter/Project chain over a scan whose materialization
+            # estimate exceeds the memory budget streams fixed-capacity
+            # chunks through the (one) compiled chain program instead
+            # of raising the memory error
+            from .streamjoin import maybe_stream_chain
+            streamed = maybe_stream_chain(self, node)
+            if streamed is not None:
+                return streamed
         if isinstance(node, AggregationNode):
             streamed = self._try_streaming_aggregation(node)
             if streamed is not None:
@@ -551,16 +574,28 @@ class Executor:
         conn = self.catalogs.connector(cur.handle.catalog)
         par = int(self.session.get("task_concurrency")) or 1
         columns = sorted(set(cur.assignments.values()))
+        # beyond-HBM chunking (exec/streamjoin.py): when the scan's
+        # materialization estimate exceeds the memory budget (or
+        # stream_chunk_rows forces it), split batches are further cut
+        # into fixed-capacity chunks streamed through double-buffered
+        # transfers, with periodic partial folding so the accumulated
+        # partial set stays bounded too
+        from .streamjoin import agg_chunk_capacity
+        stream_cap = agg_chunk_capacity(self, cur)
         # whole-table fast path: when the table is (or fits) HBM-
         # resident, the filter->project->aggregate chain runs as ONE
         # device program over all rows — the hand-fused micro's shape —
         # instead of one dispatch per split through the tunnel
         whole = (None if self.scan_partition is not None
+                 or stream_cap is not None
                  else read_table_cached(conn, cur.handle, columns, par))
         raws: Optional[List[Batch]] = None
         if whole is not None:
             raws = [whole]
-        else:
+        elif stream_cap is None:
+            # the chunked branch never reads this split list —
+            # host_scan_chunks enumerates (and share-filters) its own,
+            # and an empty share simply yields zero partials below
             splits = conn.get_splits(cur.handle, par)
             if self.scan_partition is not None:
                 part, nparts = self.scan_partition
@@ -653,12 +688,9 @@ class Executor:
                 run_jit = jax.jit(run)
                 if fkey is not None:
                     _cache_put(_STREAM_JIT_CACHE, fkey, run_jit)
-        for raw in (raws if raws is not None else
-                    (self._read_split(conn, sp, columns)
-                     for sp in splits)):
-            batch = bind(Batch({sym: raw.column(col)
-                                for sym, col in cur.assignments.items()},
-                               raw.num_rows))
+        def consume(batch: Batch) -> Batch:
+            nonlocal phys, post, recorded, run_jit, jit_hit
+            batch = bind(batch)
             if fkey is not None and not recorded \
                     and fkey not in _STREAM_JIT_DENY:
                 # deny-listed programs must not climb the pre-warm
@@ -685,11 +717,59 @@ class Executor:
                     out = run(batch)
             else:
                 out = run(batch)
-            partials.append(out)
-        merged = device_concat(partials)
+            return out
+
         from ..ops.groupby import COMBINABLE_KINDS
-        finals = [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
-                           a.output) for a in phys]
+
+        def make_finals():
+            return [AggInput(COMBINABLE_KINDS[a.kind], a.output, None,
+                             a.output) for a in phys]
+
+        if stream_cap is not None:
+            from .streamjoin import (_row_bytes, host_scan_chunks,
+                                     run_streamed)
+            # streamed peak: 2 in-flight chunk buffers + the bounded
+            # partial set the fold keeps (<= 8 chunk-capacity partials)
+            self._reserve_streamed(
+                10 * stream_cap * _row_bytes(cur.schema),
+                f"chunk-streamed aggregation over {cur.handle.table} "
+                f"(chunk capacity {stream_cap})")
+
+            def fold() -> None:
+                # re-combine the accumulated partials into one batch
+                # (combine kinds are idempotent under re-combination:
+                # sum/min/max/any) so memory stays bounded by the
+                # fold window, not the chunk count
+                nonlocal partials
+                m = device_concat(partials)
+                fin = make_finals()
+                if node_x.group_keys:
+                    g = group_aggregate(m, list(node_x.group_keys),
+                                        fin)
+                else:
+                    g = _pad_partial(global_aggregate(m, fin))
+                partials = [g]
+
+            def collect(out: Batch, i: int) -> None:
+                partials.append(out)
+                if len(partials) >= 8:
+                    fold()
+
+            run_streamed(self, "agg",
+                         host_scan_chunks(self, cur, stream_cap),
+                         lambda chunk, i: consume(chunk), collect)
+            if not partials:
+                return None    # empty scan: generic path emits empty
+        else:
+            for raw in (raws if raws is not None else
+                        (self._read_split(conn, sp, columns)
+                         for sp in splits)):
+                partials.append(consume(Batch(
+                    {sym: raw.column(col)
+                     for sym, col in cur.assignments.items()},
+                    raw.num_rows)))
+        merged = device_concat(partials)
+        finals = make_finals()
         if node_x.group_keys:
             out = group_aggregate(merged, list(node_x.group_keys),
                                   finals)
@@ -1130,8 +1210,22 @@ class Executor:
                                      for c in node.criteria),
                                node.filter)
             return self._exec_JoinNode(flipped)
+        # beyond-HBM probe streaming (exec/streamjoin.py): when the
+        # probe side is a scan chain whose working set exceeds the
+        # memory budget, build the hash table once and stream probe
+        # chunks through double-buffered host->device transfers
+        # instead of materializing the probe (BENCH_r05's q18@sf100
+        # "exceeds single-chip HBM" gap)
+        from .streamjoin import maybe_stream_join
+        streamed, pre_built = maybe_stream_join(self, node)
+        if streamed is not None:
+            return streamed
         left = self.execute(node.left)
-        right = self.execute(node.right)
+        # a declined stream decision may have materialized the build
+        # side already (the remaining-after-build check needs it):
+        # reuse that batch instead of executing node.right twice
+        right = (pre_built if pre_built is not None
+                 else self.execute(node.right))
 
         if jt == "cross" or not node.criteria:
             return self._cross_join(left, right, node.filter, jt)
@@ -1193,6 +1287,24 @@ class Executor:
             est = reserve_bytes(rows, n_lanes, limit, what)
         except MemoryLimitExceeded as e:
             raise QueryError(str(e)) from e
+        self._account(est)
+
+    def _reserve_streamed(self, nbytes: int, what: str) -> None:
+        """Reserve a streamed operator's REAL footprint (build state +
+        2 chunk buffers + 1 output chunk — exec/streamjoin.py), not
+        the full-materialization estimate streaming exists to avoid.
+        The cluster pool sees this figure too, so the low-memory
+        killer judges streamed queries by what they actually hold."""
+        limit = int(self.session.get("query_max_memory_per_node"))
+        if nbytes > limit:
+            raise QueryError(
+                f"Query exceeded per-node memory limit of {limit} "
+                f"bytes ({what} needs ~{nbytes} bytes even streamed); "
+                "raise query_max_memory_per_node or lower "
+                "stream_chunk_rows")
+        self._account(int(nbytes))
+
+    def _account(self, est: int) -> None:
         # largest single reservation = the query's peak-memory figure
         # reported in QueryCompletedEvent (capacity planning is the one
         # allocation decision point in this engine — config.py)

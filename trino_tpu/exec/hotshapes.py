@@ -214,7 +214,7 @@ def _session_budget(session) -> int:
 
 
 def record_program(kind: str, cache_key, canon, batch,
-                   session) -> None:
+                   session, payload_fn=None) -> None:
     """Executor hook: count a structural-program sighting and (first
     time) capture its AOT payload from the canonical input batch.
     ``cache_key`` is the in-process jit-cache key object — the AOT
@@ -222,7 +222,9 @@ def record_program(kind: str, cache_key, canon, batch,
     is what lets a pre-warmed program land in the exact slot the
     executor will probe. Gated per query by the ``prewarm_enabled``
     session property, with ``hot_shape_top_k`` as the query's
-    new-entry budget."""
+    new-entry budget. ``payload_fn`` overrides the default chain/
+    stream payload builder for kinds with their own transport form
+    (the streamed-join probe programs of exec/streamjoin.py)."""
     if not _session_allows(session):
         return
     # the budget is PER QUERY: keyed by the session's current query id
@@ -241,6 +243,8 @@ def record_program(kind: str, cache_key, canon, batch,
     def build() -> Optional[dict]:
         if session is not None and used >= budget:
             return None         # budget spent: hit-count only
+        if payload_fn is not None:
+            return payload_fn()
         return build_payload(kind, canon, batch)
 
     outcome = HOT_SHAPES.record(kind, repr(cache_key), build)

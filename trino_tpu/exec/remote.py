@@ -126,9 +126,13 @@ class RemoteScheduler:
         self.fragment_expected: int = 0     # tasks dispatched per frag
         self.stats: List[NodeStats] = []
         # cluster-wide resource figures: max of worker peaks (tasks run
-        # concurrently) + the coordinator combine; spill sums
+        # concurrently) + the coordinator combine; spill sums, as do
+        # the morsel-streaming rollups (chunks + h2d bytes across
+        # every worker task and the coordinator stages)
         self.peak_memory_bytes = 0
         self.spill_bytes = 0
+        self.stream_chunks = 0
+        self.stream_h2d_bytes = 0
         # fault-tolerant execution (trino_tpu/fte/): the heartbeat
         # detector receives observed task failures and is consulted
         # when picking a replacement worker; the spool receives every
@@ -362,6 +366,8 @@ class RemoteScheduler:
             self.stats = list(ex.stats)
             self.peak_memory_bytes = ex.peak_reserved_bytes
             self.spill_bytes = ex.spilled_bytes
+            self.stream_chunks = ex.stream_chunks
+            self.stream_h2d_bytes = ex.stream_h2d_bytes
             return out
         gathered = self._run_fragments(frags, payloads)
         final = _substitute(rewritten, {
@@ -371,6 +377,8 @@ class RemoteScheduler:
         self.peak_memory_bytes = max(self.peak_memory_bytes,
                                      ex.peak_reserved_bytes)
         self.spill_bytes += ex.spilled_bytes
+        self.stream_chunks += ex.stream_chunks
+        self.stream_h2d_bytes += ex.stream_h2d_bytes
         if self.collect_stats:
             # full rollup: fragment stages first (leaf-to-root order),
             # annotated with their stage, then the coordinator combine
@@ -438,6 +446,8 @@ class RemoteScheduler:
         self.peak_memory_bytes = max(self.peak_memory_bytes,
                                      ex.peak_reserved_bytes)
         self.spill_bytes += ex.spilled_bytes
+        self.stream_chunks += ex.stream_chunks
+        self.stream_h2d_bytes += ex.stream_h2d_bytes
         for peak, spill in sx.resources:
             self.peak_memory_bytes = max(self.peak_memory_bytes, peak)
             self.spill_bytes += spill
@@ -733,6 +743,11 @@ class RemoteScheduler:
                     worker_resources.append((
                         int(status.get("peakMemoryBytes") or 0),
                         int(status.get("spillBytes") or 0)))
+                    with self._stats_lock:
+                        self.stream_chunks += int(
+                            status.get("streamChunks") or 0)
+                        self.stream_h2d_bytes += int(
+                            status.get("streamH2dBytes") or 0)
                     if trace is not None:
                         sp = trace.record(
                             f"fragment_{f.fid}_execute", t0, t1,
@@ -1165,6 +1180,8 @@ class DistributedHostQueryRunner:
         res.trace = trace
         res.peak_memory_bytes = sched.peak_memory_bytes
         res.spill_bytes = sched.spill_bytes
+        res.stream_chunks = sched.stream_chunks
+        res.stream_h2d_bytes = sched.stream_h2d_bytes
         if self.collect_node_stats:
             res.stats = sched.stats
         return res

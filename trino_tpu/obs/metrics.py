@@ -348,6 +348,21 @@ STAGES_SCHEDULED = METRICS.counter(
     "trino_tpu_stages_scheduled_total",
     "Worker stages dispatched by the stage-DAG scheduler")
 
+# beyond-HBM morsel streaming (exec/streamjoin.py): registered here —
+# not in the lazily-imported streaming module — so every consumer
+# (bench deltas, /metrics scrapes, tests) sees the same labeled
+# families regardless of import order
+STREAM_CHUNKS = METRICS.counter(
+    "trino_tpu_stream_chunks_total",
+    "Chunks processed by morsel-streamed operators", ("op",))
+STREAM_H2D_BYTES = METRICS.counter(
+    "trino_tpu_stream_bytes_h2d_total",
+    "Bytes moved host->device by streamed-operator chunk transfers")
+STREAM_OVERLAPPED = METRICS.counter(
+    "trino_tpu_stream_transfers_overlapped_total",
+    "Chunk transfers issued while the previous chunk's compute was "
+    "still in flight (the double-buffer overlap)")
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

@@ -257,3 +257,21 @@ class QueryMemoryContext:
 
     def reserve(self, nbytes: int) -> None:
         self._manager.reserve(self.query_id, nbytes)
+
+    def budget_bytes(self) -> Optional[int]:
+        """The tightest byte budget governing this query (its own
+        query_max_memory cap, its group's soft limit, the pool size) —
+        None when nothing binds. The streaming engagement check
+        (exec/streamjoin.py memory_budget) consults this so a query
+        that would breach the POOL un-streamed engages streaming and
+        reserves its streamed peak instead of getting killed on the
+        full-materialization estimate."""
+        m = self._manager
+        with m._lock:
+            entry = m._queries.get(self.query_id)
+        if entry is None:
+            return None
+        _, _, group_limit, query_limit = entry
+        vals = [v for v in (query_limit, group_limit,
+                            m.pool.max_bytes) if v and v > 0]
+        return min(vals) if vals else None

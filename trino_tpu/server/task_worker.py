@@ -119,6 +119,11 @@ class _Task:
         self.hot_shapes: List[dict] = []
         self.peak_memory_bytes = 0
         self.spill_bytes = 0
+        # morsel streaming (exec/streamjoin.py): chunk count + h2d
+        # bytes this task's streamed operators moved, rolled up by the
+        # schedulers next to peak memory
+        self.stream_chunks = 0
+        self.stream_h2d_bytes = 0
         self.done = threading.Event()
 
     def run(self, payload: dict):
@@ -200,6 +205,8 @@ class _Task:
                 self.node_stats = [s.to_dict() for s in ex.stats]  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.peak_memory_bytes = ex.peak_reserved_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.spill_bytes = ex.spilled_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.stream_chunks = ex.stream_chunks  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.stream_h2d_bytes = ex.stream_h2d_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             else:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
@@ -437,7 +444,9 @@ class TaskWorkerServer:
                          "spans": t.spans,
                          "hotShapes": t.hot_shapes,
                          "peakMemoryBytes": t.peak_memory_bytes,
-                         "spillBytes": t.spill_bytes}).encode()
+                         "spillBytes": t.spill_bytes,
+                         "streamChunks": t.stream_chunks,
+                         "streamH2dBytes": t.stream_h2d_bytes}).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))

@@ -121,6 +121,16 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # flood the feed); also the default count served at /v1/hotshapes
     # when the puller names no k
     "hot_shape_top_k": (int, CONFIG.prewarm_top_k),
+    # ---- beyond-HBM morsel streaming (exec/streamjoin.py) ------------
+    # chunk row count for streamed operators: 0 (default) auto-engages
+    # streaming only when an operator's full-materialization estimate
+    # exceeds the memory budget, with the chunk capacity derived from
+    # the budget; > 0 FORCES every streamable scan chain / probe join
+    # / streaming aggregation to chunk at (the power-of-two bucket of)
+    # this row count — tests and bench pin the capacity this way;
+    # < 0 disables streaming (fall back to the materialized path and
+    # its memory errors — the operator escape hatch)
+    "stream_chunk_rows": (int, CONFIG.stream_chunk_rows),
 }
 
 

@@ -242,6 +242,13 @@ class StageExecution:
                 # worker-side joins/aggregations too (exec/hotshapes)
                 from ..exec.hotshapes import HOT_SHAPES
                 HOT_SHAPES.merge(status.get("hotShapes") or [])
+                with s._stats_lock:
+                    # morsel-streaming rollup: stage tasks report
+                    # their chunk counts + h2d bytes like peak memory
+                    s.stream_chunks += int(
+                        status.get("streamChunks") or 0)
+                    s.stream_h2d_bytes += int(
+                        status.get("streamH2dBytes") or 0)
                 if speculative:
                     with s._stats_lock:
                         s.speculative_wins += 1
