@@ -387,14 +387,61 @@ def _leg_fault(iters: int) -> dict:
     }, **_cw_keys(cold_ok, t_ok))
 
 
+def _mpp_ici_subleg(sql: str, nrows: int) -> dict:
+    """ICI-native exchange mini-leg: the SAME stage DAG, executed on a
+    4-virtual-device mesh with the hash repartition lowered to
+    jax.lax.all_to_all (stage/ici.py) instead of spool+HTTP frames.
+    Runs in a grandchild process because the virtual-device XLA flag
+    must be set before jax imports (and must not perturb the other
+    legs' single-device baseline)."""
+    code = (
+        "import json, os, time\n"
+        "from trino_tpu.runner import LocalQueryRunner\n"
+        "from trino_tpu.obs.metrics import METRICS\n"
+        "sql = os.environ['BENCH_MPP_SQL']\n"
+        "r = LocalQueryRunner(distributed=True, n_devices=4)\n"
+        "r.execute(sql)\n"
+        "b = METRICS.counter('trino_tpu_exchange_ici_bytes_total')\n"
+        "b0 = sum(v for _, v in b.samples())\n"
+        "t0 = time.perf_counter(); r.execute(sql)\n"
+        "wall = time.perf_counter() - t0\n"
+        "moved = sum(v for _, v in b.samples()) - b0\n"
+        "print(json.dumps({'wall_s': wall, 'ici_bytes': moved}))\n")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=4"
+                        ).strip()
+    # ONE timed iteration after the warm-up: the mesh path re-traces
+    # its shard_map programs per query (known spmd cost), so extra
+    # iterations buy accuracy at ~1 re-compile each — the CPU probe's
+    # budget is better spent on the worker legs
+    env["BENCH_MPP_SQL"] = sql
+    budget = min(max(_remaining() * 0.5, 30.0), 150.0)
+    try:
+        p = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=budget, env=env)
+        d = json.loads((p.stdout or "").strip().splitlines()[-1])
+        return {"ici_rows_per_sec": nrows / max(d["wall_s"], 1e-9),
+                "exchange_ici_bytes": float(d["ici_bytes"])}
+    except Exception as e:      # noqa: BLE001 — the split stays a
+        # reported 0, never a lost worker-leg result
+        return {"exchange_ici_bytes": 0.0,
+                "ici_error": f"{type(e).__name__}: {e}"[:160]}
+
+
 def _leg_mpp(iters: int) -> dict:
     """Multi-stage MPP leg: a distributed hash-join + final-aggregation
     query through the stage-DAG scheduler (trino_tpu/stage/) — joins
     and the final aggregation run ON the workers over the partitioned
-    worker-to-worker exchange — at 1 vs N in-process workers. Reports
-    rows/s (lineitem rows / best wall) and the exchange bytes the
-    N-worker run moved, so worker-side execution is a tracked metric
-    next to cpu_engine_rows_per_sec."""
+    worker-to-worker exchange — at 1 vs 3 in-process workers, with the
+    per-stage-barrier vs eager-pipelining A/B (stage_pipelining) and
+    the ICI-vs-spool exchange byte split. Reports rows/s (lineitem
+    rows / best wall), the pipelining overlap ratio, and the exchange
+    bytes each medium moved, so worker-side execution is a tracked
+    metric next to cpu_engine_rows_per_sec."""
     from trino_tpu.exec.remote import DistributedHostQueryRunner
     from trino_tpu.obs.metrics import METRICS
     from trino_tpu.runner import LocalQueryRunner
@@ -408,9 +455,10 @@ def _leg_mpp(iters: int) -> dict:
         session=Session(catalog="tpch", schema="tiny")).execute(
             "SELECT count(*) FROM lineitem").rows[0][0])
 
-    def make_session():
+    def make_session(pipelining: bool = True):
         s = Session(catalog="tpch", schema="tiny")
         s.set("multistage_execution", True)
+        s.set("stage_pipelining", pipelining)
         return s
 
     def ex_bytes_written():
@@ -423,28 +471,38 @@ def _leg_mpp(iters: int) -> dict:
 
     nruns = max(iters, 1) + 1       # warm-up + timed iterations
 
-    def best_of(uris):
-        r = DistributedHostQueryRunner(uris, session=make_session())
+    def best_of(uris, pipelining: bool = True):
+        r = DistributedHostQueryRunner(
+            uris, session=make_session(pipelining))
         return _cold_warm(lambda: r.execute(sql), iters)
 
     workers = [TaskWorkerServer().start() for _ in range(3)]
     try:
         uris = [w.base_uri for w in workers]
         _, t_one = best_of(uris[:1])
+        # the A/B: identical DAG, identical fleet — only the barrier
+        # differs (stage_pipelining=false is the pre-PR-13 behavior)
+        _, t_barrier = best_of(uris, pipelining=False)
         b0 = ex_bytes_written()
-        cold_all, t_all = best_of(uris)
+        cold_all, t_all = best_of(uris, pipelining=True)
         # identical runs: the per-query shuffle volume is the written
         # delta divided by how many times the query executed
         moved = (ex_bytes_written() - b0) / nruns
+        overlap = METRICS.gauge(
+            "trino_tpu_mpp_pipeline_overlap_ratio").value()
     finally:
         for w in workers:
             w.stop()
     return dict({
         "rows_per_sec": nrows / t_all,
         "rows_per_sec_1_worker": nrows / t_one,
+        "rows_per_sec_barrier": nrows / t_barrier,
         "speedup_vs_1_worker": t_one / t_all,
+        "pipelined_speedup_vs_barrier": t_barrier / t_all,
+        "pipeline_overlap_ratio": overlap,
         "exchange_bytes": moved,
-    }, **_cw_keys(cold_all, t_all))
+        "exchange_spool_bytes": moved,
+    }, **_mpp_ici_subleg(sql, nrows), **_cw_keys(cold_all, t_all))
 
 
 def _leg_load(duration_s: float, clients: int) -> dict:
@@ -661,13 +719,21 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False):
                       "datagen_s"):
                 if k in d:
                     vals[f"{leg}_{k}"] = d[k]
-            # mpp leg ride-alongs: worker-side execution artifacts
+            # mpp leg ride-alongs: worker-side execution artifacts,
+            # the barrier-vs-pipelined A/B, and the ICI/spool split
             if "speedup_vs_1_worker" in d:
                 vals["mpp_speedup"] = d["speedup_vs_1_worker"]
             if "exchange_bytes" in d:
                 vals["mpp_exchange_bytes"] = d["exchange_bytes"]
             if "rows_per_sec_1_worker" in d:
                 vals["mpp_1_worker"] = d["rows_per_sec_1_worker"]
+            for k in ("rows_per_sec_barrier",
+                      "pipelined_speedup_vs_barrier",
+                      "pipeline_overlap_ratio",
+                      "exchange_spool_bytes", "exchange_ici_bytes",
+                      "ici_rows_per_sec"):
+                if k in d:
+                    vals[f"mpp_{k}"] = d[k]
         elif "overhead" in d:
             vals[d.get("leg", "?")] = d["overhead"]
             # fault leg ride-alongs: scrape-side FTE artifacts
@@ -856,14 +922,29 @@ def main():
         "query_peak_memory_bytes": round(
             cpu_vals.get("peak_memory_bytes", 0.0) or 0.0, 1),
         # multi-stage MPP (trino_tpu/stage/): a distributed hash-join +
-        # final-aggregation query with joins/aggs executing ON workers;
-        # rows/s at 3 workers, the 1-worker ratio, and the exchange
-        # bytes the partitioned shuffle moved
+        # final-aggregation query with joins/aggs executing ON workers
+        # (default-on engine since PR 13); rows/s at 3 workers with
+        # eager pipelining, the 1-worker and per-stage-barrier ratios,
+        # the pipelining overlap ratio, and the exchange byte split —
+        # spool/HTTP frames vs ICI device collectives (stage/ici.py)
         "mpp_rows_per_sec": round(cpu_vals.get("mpp", 0.0) or 0.0, 1),
         "mpp_speedup_vs_1_worker": round(
             cpu_vals.get("mpp_speedup", 0.0) or 0.0, 2),
+        "mpp_rows_per_sec_barrier": round(
+            cpu_vals.get("mpp_rows_per_sec_barrier", 0.0) or 0.0, 1),
+        "mpp_pipelined_speedup_vs_barrier": round(
+            cpu_vals.get("mpp_pipelined_speedup_vs_barrier", 0.0)
+            or 0.0, 3),
+        "mpp_pipeline_overlap_ratio": round(
+            cpu_vals.get("mpp_pipeline_overlap_ratio", 0.0) or 0.0, 4),
         "mpp_exchange_bytes": round(
             cpu_vals.get("mpp_exchange_bytes", 0.0) or 0.0, 1),
+        "exchange_spool_bytes_total": round(
+            cpu_vals.get("mpp_exchange_spool_bytes", 0.0) or 0.0, 1),
+        "exchange_ici_bytes_total": round(
+            cpu_vals.get("mpp_exchange_ici_bytes", 0.0) or 0.0, 1),
+        "mpp_ici_rows_per_sec": round(
+            cpu_vals.get("mpp_ici_rows_per_sec", 0.0) or 0.0, 1),
         # overload governance (server/resourcegroups.py + memory.py):
         # closed-loop load — K concurrent clients for a fixed duration
         # against a hard_concurrency=2 group. QPS + latency percentiles

@@ -505,8 +505,15 @@ def test_single_host_query_spools_bytes_once(tmp_path, expected):
     written = _counter("trino_tpu_spool_bytes_written_total")
     coalesced = _counter("trino_tpu_spool_coalesced_commits_total")
     try:
+        # flat-path pin: commit coalescing (X-TT-Spool-Dir hard links)
+        # is the leaf-fragment path's coordinator-side double-write
+        # optimization; stage tasks never re-commit at the coordinator
+        # (their frames stay on the worker spools), so the stage path
+        # has nothing to coalesce by construction
+        sess = _task_session()
+        sess.set("multistage_execution", False)
         runner = DistributedHostQueryRunner(
-            [w1.base_uri, w2.base_uri], session=_task_session(),
+            [w1.base_uri, w2.base_uri], session=sess,
             spool=LocalDirSpool(str(tmp_path / "coord-spool")))
         res = runner.execute(SQL)
     finally:

@@ -359,9 +359,16 @@ def test_speculation_rescues_straggler(workers, expected):
     hung = _FaultyWorker("hang")
     wins_before = _counter("trino_tpu_speculative_wins_total")
     try:
+        # flat-path pin: this exercises the leaf-fragment scheduler's
+        # speculation machinery (the explicit fallback since PR 13 —
+        # the stage-path twin lives in test_stage_mpp). Under the
+        # stage scheduler a 202-forever status poll is a malformed
+        # status, failing the attempt into a plain retry instead of a
+        # page-pull wedge.
         runner = DistributedHostQueryRunner(
             [hung.base_uri] + workers,
-            session=_task_session(speculation_enabled=True,
+            session=_task_session(multistage_execution=False,
+                                  speculation_enabled=True,
                                   speculation_multiplier=1.5,
                                   speculation_min_runtime_ms=100))
         res = runner.execute(SQL)
@@ -555,9 +562,14 @@ def test_worker_killed_with_objectstore_spool_backend(workers,
     killed = _FaultyWorker("kill")
     ops_before = ops_total()
     try:
+        # flat-path pin: the coordinator-side spool (the injected
+        # object-store emulation here) only receives fragment output
+        # on the leaf-fragment path — stage tasks commit to WORKER
+        # spools and the coordinator reads the final gather off them
         runner = DistributedHostQueryRunner(
             [killed.base_uri] + workers,
-            session=_task_session(), spool=spool)
+            session=_task_session(multistage_execution=False),
+            spool=spool)
         res = runner.execute(SQL)
     finally:
         killed.stop()

@@ -296,10 +296,21 @@ def worker_uris():
         w.stop()
 
 
+def _flat_session() -> Session:
+    """Flat-path pin for the leaf-fragment observability trio below:
+    these assert the scatter-gather path's `fragment N xM workers`
+    stats tags and `fragment_N_execute` spans — the explicit fallback
+    since PR 13 (multistage default-on). The stage-DAG flavor of the
+    same guarantees (per-STAGE tags, stage_N_execute spans, the stage
+    section in EXPLAIN ANALYZE) is covered in test_stage_mpp.py."""
+    return Session(catalog="tpch", schema="tiny",
+                   properties={"multistage_execution": False})
+
+
 def test_distributed_stats_rollup_sums_to_totals(worker_uris):
     from trino_tpu.exec.remote import DistributedHostQueryRunner
     d = DistributedHostQueryRunner(
-        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        worker_uris, session=_flat_session(),
         collect_node_stats=True)
     res = d.execute("SELECT count(*) AS n FROM lineitem")
     total = res.rows[0][0]
@@ -320,7 +331,7 @@ def test_distributed_stats_rollup_sums_to_totals(worker_uris):
 def test_distributed_span_tree_has_fragment_children(worker_uris):
     from trino_tpu.exec.remote import DistributedHostQueryRunner
     d = DistributedHostQueryRunner(
-        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        worker_uris, session=_flat_session(),
         collect_node_stats=True)
     res = d.execute("SELECT sum(l_quantity) FROM lineitem")
     roots = [s.name for s in res.trace.roots]
@@ -339,7 +350,7 @@ def test_distributed_span_tree_has_fragment_children(worker_uris):
 def test_distributed_explain_analyze_per_fragment(worker_uris):
     from trino_tpu.exec.remote import DistributedHostQueryRunner
     d = DistributedHostQueryRunner(
-        worker_uris, session=Session(catalog="tpch", schema="tiny"),
+        worker_uris, session=_flat_session(),
         collect_node_stats=True)
     res = d.execute(
         "EXPLAIN ANALYZE SELECT l_returnflag, count(*) FROM lineitem "

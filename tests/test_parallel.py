@@ -126,10 +126,11 @@ def test_graft_entry():
     ge.dryrun_multichip(8)
 
 
-@pytest.mark.slow
 def test_range_repartition_distributed_sort(mesh):
     """Sampled range exchange + per-shard sort == global ORDER BY
-    (exec/distributed.py _dexec_SortNode building blocks)."""
+    (exec/distributed.py _dexec_SortNode building blocks).
+    Ungated in PR 13: the in-slice path rides the stage scheduler now,
+    so the collective building blocks are tier-1 load-bearing."""
     from trino_tpu.ops.sort import SortKey, sort_batch
     from trino_tpu.parallel.spmd import (range_dest_counts,
                                          repartition_by_range,
@@ -174,10 +175,12 @@ def test_distributed_sort_sql_matches_local():
     assert dist == local
 
 
-@pytest.mark.slow
 def test_distributed_window_matches_local():
     """q47-style windowed aggregation: hash repartition by partition
-    keys + per-shard window == local (round-4 verdict weak #6)."""
+    keys + per-shard window == local (round-4 verdict weak #6).
+    Ungated in PR 13: this plan now fragments into the stage DAG and
+    executes through the ICI stage path (stage/ici.py), so it proves
+    the unified in-slice engine end to end in tier 1."""
     q = ("SELECT o_custkey, o_orderkey, "
          "rank() OVER (PARTITION BY o_custkey ORDER BY o_totalprice DESC) "
          "AS r, sum(o_totalprice) OVER (PARTITION BY o_custkey) AS s "

@@ -49,16 +49,19 @@ def test_prewarm_properties_defaults_and_types():
 
 
 def test_multistage_execution_gates_the_stage_fragmenter():
-    """The stage-DAG path is opt-in: default off, and the scheduler
-    consults the session property (its intermediate-fan-out behavior
-    is covered end-to-end in test_stage_mpp.py)."""
+    """The stage-DAG path IS the engine (default ON since PR 13); the
+    session property is the explicit fallback knob to the flat
+    scatter-gather path (end-to-end behavior in test_stage_mpp.py)."""
     from trino_tpu.exec.remote import RemoteScheduler
     sched = RemoteScheduler.__new__(RemoteScheduler)
     sched.session = Session()
-    assert not sched._multistage_enabled()
-    sched.session.set("multistage_execution", True)
     assert sched._multistage_enabled()
+    sched.session.set("multistage_execution", False)
+    assert not sched._multistage_enabled()
     assert int(sched.session.get("exchange_partition_count")) == 0
+    # the pipelining + ICI knobs ship default-on next to it
+    assert sched.session.get("stage_pipelining") is True
+    assert sched.session.get("ici_exchange") is True
 
 
 def test_unknown_property_rejected():

@@ -130,6 +130,12 @@ def test_partition_frames_layout():
     gather = partition_frames(b, (), "gather", 5)
     assert len(gather) == 1
     assert deserialize_batch(gather[0]).num_rows_host() == 3
+    # replicate spools ONE frame (not one per consumer task): the
+    # broadcast fan-out lives on the consumer side (every task reads
+    # frame 0 — stage/exchange.py), so the bytes are written once
+    rep = partition_frames(b, (), "replicate", 5)
+    assert len(rep) == 1
+    assert deserialize_batch(rep[0]).num_rows_host() == 3
 
 
 # --------------------------------------------------------------------------
@@ -175,13 +181,34 @@ def test_fragmenter_cuts_join_agg_dag():
     assert sorted(payloads) == [st.sid for st in dag.stages]
 
 
-def test_fragmenter_declines_unsupported_shapes():
-    """Semi joins (NULL-IN semantics need replicate-nulls) and
-    non-remotable scans stay on the flat path."""
+def test_fragmenter_semi_join_replicates_filtering_source():
+    """Semi joins fragment now: the filtering source becomes a
+    REPLICATE stage (every task sees the whole relation, so NULL-IN
+    semantics hold per task) and the probe scan stays INLINE in the
+    consuming stage — no probe-side exchange hop."""
+    from trino_tpu.analysis.sanity import walk_plan
+    from trino_tpu.plan.nodes import SemiJoinNode, TableScanNode
     r, plan = _optimized(
         "SELECT count(*) FROM orders WHERE o_custkey IN "
         "(SELECT c_custkey FROM customer)")
-    assert StageFragmenter(r.catalogs, r.session).fragment(plan) is None
+    dag = StageFragmenter(r.catalogs, r.session).fragment(plan)
+    assert dag is not None
+    kinds = {st.sid: st.output_node.kind for st in dag.stages}
+    assert "replicate" in kinds.values()
+    # the semi-join stage carries BOTH the probe scan and the semi join
+    # (colocated — the probe never crossed an exchange)
+    for st in dag.stages:
+        names = {type(n).__name__ for n in walk_plan(st.plan)}
+        if "SemiJoinNode" in names:
+            assert "TableScanNode" in names
+            break
+    else:
+        raise AssertionError("no stage carries the semi join")
+
+
+def test_fragmenter_declines_unsupported_shapes():
+    """Non-remotable (coordinator-state-backed) scans stay on the flat
+    path."""
     r2, plan2 = _optimized(
         "SELECT node_id, count(*) FROM system.runtime.nodes "
         "GROUP BY node_id")
@@ -357,9 +384,13 @@ def test_explain_analyze_proves_worker_side_execution(workers):
 def test_exchange_partition_count_caps_intermediate_fanout(workers):
     """Session-property plumbing, end to end: the intermediate stages
     run exactly exchange_partition_count tasks while leaves keep the
-    per-worker fan-out."""
+    per-worker fan-out. PARTITIONED distribution pinned — under the
+    default AUTOMATIC the tiny build side makes the join REPLICATED,
+    which colocates it with the probe scan (leaf fan-out by design)."""
     dist = DistributedHostQueryRunner(
-        workers, session=_mpp_session(exchange_partition_count=1))
+        workers, session=_mpp_session(
+            exchange_partition_count=1,
+            join_distribution_type="PARTITIONED"))
     res = dist.execute("EXPLAIN ANALYZE " + JOIN_AGG_SQL)
     text = "\n".join(r[0] for r in res.rows)
     joins = [l for l in text.splitlines() if l.startswith("Join:")]
@@ -373,6 +404,17 @@ def test_exchange_partition_count_caps_intermediate_fanout(workers):
 # per-stage fault tolerance: mid-DAG kill + straggler speculation
 # --------------------------------------------------------------------------
 
+def _kill_server(worker) -> None:
+    """shutdown + close: connections REFUSE immediately (a dead
+    process), instead of a zombie listening socket absorbing
+    30s-timeout polls — the half-open-socket shape is covered by the
+    eager-pull candidate sweep's short probe timeout."""
+    def stop():
+        worker._httpd.shutdown()
+        worker._httpd.server_close()
+    threading.Thread(target=stop, daemon=True).start()
+
+
 class _SabotagedWorker(TaskWorkerServer):
     """Executes leaf-stage tasks normally (committing their output to
     the spool), then DIES the first time it receives a mid-DAG
@@ -384,8 +426,7 @@ class _SabotagedWorker(TaskWorkerServer):
         if stage.get("sources") and not getattr(self, "_killed",
                                                 False):
             self._killed = True
-            threading.Thread(target=self._httpd.shutdown,
-                             daemon=True).start()
+            _kill_server(self)
             raise ConnectionResetError("killed mid-DAG")
         return super().create_task(tid, payload)
 
@@ -420,6 +461,75 @@ def test_mid_dag_worker_kill_recovers_off_spool():
     walk(res.trace.to_dicts())
     assert any(n.startswith("stage_") and n.endswith("_retry")
                for n in names), names
+
+
+class _RecordingWorker(TaskWorkerServer):
+    """Records every task id it is asked to execute (attempt
+    bookkeeping for the replay-scope assertion below)."""
+
+    def __init__(self, *a, **k):
+        super().__init__(*a, **k)
+        self.seen = []
+
+    def create_task(self, tid, payload):
+        self.seen.append(tid)
+        return super().create_task(tid, payload)
+
+
+class _RecordingSabotagedWorker(_RecordingWorker):
+    """Executes leaf tasks normally, records everything, then DIES on
+    its first mid-DAG (exchange-fed) task — the mid-pipeline kill."""
+
+    def create_task(self, tid, payload):
+        stage = payload.get("stage") or {}
+        if stage.get("sources") and not getattr(self, "_killed",
+                                                False):
+            self._killed = True
+            self.seen.append(tid)
+            _kill_server(self)
+            raise ConnectionResetError("killed mid-pipeline")
+        return super().create_task(tid, payload)
+
+
+def test_mid_pipeline_kill_replays_only_uncommitted():
+    """THE pipelining chaos contract: a worker killed while the DAG is
+    eagerly pipelined costs only the partitions it had NOT yet
+    committed. Every (stage, part) task that ran more than once must
+    have lost its FIRST attempt to the killed worker — a task whose
+    first attempt committed on a surviving worker is never
+    re-executed (consumers re-pull its committed frames off the spool
+    instead)."""
+    bad = _RecordingSabotagedWorker().start()
+    good = [_RecordingWorker().start() for _ in range(2)]
+    retries_before = _counter("trino_tpu_task_retries_total")
+    try:
+        runner = DistributedHostQueryRunner(
+            [bad.base_uri] + [g.base_uri for g in good],
+            session=_mpp_session(retry_policy="TASK",
+                                 retry_initial_delay_ms=10,
+                                 remote_task_timeout=60))
+        res = runner.execute(JOIN_AGG_SQL)
+    finally:
+        for g in good:
+            g.stop()
+    exp = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(
+            JOIN_AGG_SQL)
+    assert res.rows == exp.rows
+    assert _counter("trino_tpu_task_retries_total") > retries_before
+    # attempt ledger: tid == <qid>.s<sid>.<part>.a<attempt>
+    execs = {}
+    for who, w in [("bad", bad)] + [("good", g) for g in good]:
+        for tid in w.seen:
+            _, s, p, a = tid.rsplit(".", 3)
+            execs.setdefault((s, p), []).append((int(a[1:]), who))
+    replayed = {k: sorted(v) for k, v in execs.items() if len(v) > 1}
+    assert replayed, "the kill must have forced at least one replay"
+    for key, attempts in replayed.items():
+        assert attempts[0][1] == "bad", (
+            f"task {key} was re-executed although its first attempt "
+            f"ran on a surviving worker: {attempts} — a committed "
+            "partition was replayed")
 
 
 class _StuckWorker:
@@ -488,6 +598,131 @@ def test_stage_speculation_rescues_straggler(workers):
             JOIN_AGG_SQL)
     assert res.rows == exp.rows
     assert _counter("trino_tpu_speculative_wins_total") > wins_before
+
+
+def test_mpp_semi_join_matches_local(workers):
+    """NULL-IN semantics through the replicate exchange: the filtering
+    side (with NULL-capable keys) replicates whole, so the per-task
+    verdicts equal the local engine's."""
+    _check(workers,
+           "SELECT count(*) FROM orders WHERE o_custkey IN "
+           "(SELECT c_custkey FROM customer WHERE c_acctbal > 0)")
+    _check(workers,
+           "SELECT count(*) FROM customer WHERE c_custkey NOT IN "
+           "(SELECT o_custkey FROM orders WHERE o_totalprice > 100000)")
+
+
+def test_mpp_cross_join_matches_local(workers):
+    _check(workers,
+           "SELECT count(*) FROM nation CROSS JOIN region")
+    # non-equi join filter (no equi-criteria): replicate-right shape
+    _check(workers,
+           "SELECT n1.n_name, n2.n_name FROM nation n1 "
+           "JOIN nation n2 ON n1.n_nationkey < n2.n_nationkey "
+           "WHERE n1.n_regionkey = 0 ORDER BY 1, 2")
+
+
+def test_mpp_grouping_sets_matches_local(workers):
+    """Grouping sets repartition rows on (keys..., grouping-set id):
+    GroupIdNode expands split-locally in the producer stage, subtotal
+    copies' NULLed key lanes hash identically everywhere."""
+    _check(workers,
+           "SELECT n_regionkey, n_name, count(*) FROM nation "
+           "GROUP BY ROLLUP(n_regionkey, n_name) ORDER BY 1, 2")
+    _check(workers,
+           "SELECT o_orderstatus, o_orderpriority, count(*), "
+           "sum(o_totalprice) FROM orders GROUP BY GROUPING SETS "
+           "((o_orderstatus), (o_orderpriority), ()) ORDER BY 1, 2",
+           approx=(3,))
+
+
+def test_mpp_grouping_sets_fragment_shape():
+    """The DAG proof behind the e2e: a ROLLUP aggregation fragments
+    with the GroupIdNode INSIDE the producer stage and the hash
+    exchange keyed on the full key tuple incl. the set id."""
+    from trino_tpu.analysis.sanity import walk_plan
+    from trino_tpu.plan.nodes import AggregationNode, GroupIdNode
+    r, plan = _optimized(
+        "SELECT n_regionkey, n_name, count(*) FROM nation "
+        "GROUP BY ROLLUP(n_regionkey, n_name)")
+    dag = StageFragmenter(r.catalogs, r.session).fragment(plan)
+    assert dag is not None
+    producer = next(st for st in dag.stages
+                    if any(isinstance(n, GroupIdNode)
+                           for n in walk_plan(st.plan)))
+    agg = next(n for st in dag.stages
+               for n in walk_plan(st.plan)
+               if isinstance(n, AggregationNode))
+    assert agg.group_id_symbol is not None
+    assert agg.group_id_symbol in producer.output_node.partition_keys
+
+
+# --------------------------------------------------------------------------
+# eager pipelining: consumer pulls while producers run
+# --------------------------------------------------------------------------
+
+def test_pipelining_matches_barrier_and_overlaps(workers):
+    """The tentpole A/B: identical results with stage_pipelining on
+    and off; the pipelined run shows cross-stage overlap (tasks of
+    >= 2 stages in flight concurrently), the barrier run none.
+    PARTITIONED distribution keeps >= 4 stages in the DAG so there is
+    something to overlap."""
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    gauge = METRICS.gauge("trino_tpu_mpp_pipeline_overlap_ratio")
+    _check(workers, TPCH_QUERIES[3], approx=(1,),
+           join_distribution_type="PARTITIONED",
+           stage_pipelining=False)
+    assert gauge.value() == 0.0
+    _check(workers, TPCH_QUERIES[3], approx=(1,),
+           join_distribution_type="PARTITIONED",
+           stage_pipelining=True)
+    assert gauge.value() > 0.0
+
+
+# --------------------------------------------------------------------------
+# ICI-native exchange: the stage DAG on the device mesh
+# --------------------------------------------------------------------------
+
+def test_ici_stage_execution_matches_local():
+    """The in-slice unification: LocalQueryRunner(distributed=True)
+    routes fragmentable plans through the SAME stage DAG with the hash
+    repartition lowered to jax.lax.all_to_all (stage/ici.py) — results
+    equal the local engine and the ICI byte counter moves while the
+    spool counter does not."""
+    sql = ("SELECT o_orderpriority, count(*), sum(l_extendedprice) "
+           "FROM lineitem JOIN orders ON l_orderkey = o_orderkey "
+           "GROUP BY o_orderpriority ORDER BY o_orderpriority")
+    loc = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(sql)
+    ici_b = _counter("trino_tpu_exchange_ici_bytes_total")
+    spool_b = _counter("trino_tpu_exchange_partition_bytes_total")
+    dist = LocalQueryRunner(distributed=True, n_devices=8,
+                            session=Session(catalog="tpch",
+                                            schema="tiny")).execute(sql)
+    assert len(dist.rows) == len(loc.rows)
+    for d, l in zip(dist.rows, loc.rows):
+        assert d[0] == l[0] and d[1] == l[1]
+        assert d[2] == pytest.approx(l[2], rel=1e-9)
+    assert _counter("trino_tpu_exchange_ici_bytes_total") > ici_b
+    assert _counter(
+        "trino_tpu_exchange_partition_bytes_total") == spool_b
+
+
+def test_ici_exchange_off_keeps_node_path():
+    """The escape hatch: ici_exchange=false keeps the node-at-a-time
+    distributed executor — same answers, no ICI edge counted."""
+    sql = ("SELECT n_name, count(*) FROM nation "
+           "JOIN customer ON c_nationkey = n_nationkey "
+           "GROUP BY n_name ORDER BY 1")
+    loc = LocalQueryRunner(
+        session=Session(catalog="tpch", schema="tiny")).execute(sql)
+    edges = _counter("trino_tpu_exchange_ici_edges_total")
+    s = Session(catalog="tpch", schema="tiny")
+    s.set("ici_exchange", False)
+    dist = LocalQueryRunner(distributed=True, n_devices=8,
+                            session=s).execute(sql)
+    assert dist.rows == loc.rows
+    assert _counter("trino_tpu_exchange_ici_edges_total") == edges
 
 
 def test_partition_endpoint_serves_committed_frames():

@@ -568,9 +568,9 @@ class StageBoundaryChecker:
             if po.kind == "hash" and not po.partition_keys:
                 raise _Violation(
                     f"stage {st.sid} hash-partitions with no keys")
-            if po.kind == "gather" and po.partition_keys:
+            if po.kind in ("gather", "replicate") and po.partition_keys:
                 raise _Violation(
-                    f"stage {st.sid} gathers but carries partition "
+                    f"stage {st.sid} {po.kind}s but carries partition "
                     f"keys {list(po.partition_keys)}")
         for where, plan in [(f"stage {st.sid}", st.plan)
                             for st in stages] + [("root", root_plan)]:

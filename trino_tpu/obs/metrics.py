@@ -347,6 +347,25 @@ EXCHANGE_PARTITION_BYTES = METRICS.counter(
 STAGES_SCHEDULED = METRICS.counter(
     "trino_tpu_stages_scheduled_total",
     "Worker stages dispatched by the stage-DAG scheduler")
+# eager stage pipelining (stage/scheduler.py): the last query's share
+# of exchange-connected wall time where tasks of >= 2 different stages
+# ran concurrently (0 under the per-stage barrier; the bench mpp leg's
+# mpp_pipeline_overlap_ratio)
+MPP_OVERLAP_RATIO = METRICS.gauge(
+    "trino_tpu_mpp_pipeline_overlap_ratio",
+    "Pipelined stage overlap of the most recent stage-DAG query")
+# ICI-native exchange (stage/ici.py): bytes moved by device-collective
+# stage boundaries (jax.lax.all_to_all / in-slice replication) — the
+# counterpart of the spool/HTTP leg's
+# trino_tpu_exchange_partition_bytes_total
+EXCHANGE_ICI_BYTES = METRICS.counter(
+    "trino_tpu_exchange_ici_bytes_total",
+    "Bytes exchanged at in-slice (device collective) stage boundaries",
+    ("kind",))
+EXCHANGE_ICI_EDGES = METRICS.counter(
+    "trino_tpu_exchange_ici_edges_total",
+    "Stage-boundary exchanges lowered to in-slice device collectives",
+    ("kind",))
 
 # beyond-HBM morsel streaming (exec/streamjoin.py): registered here —
 # not in the lazily-imported streaming module — so every consumer

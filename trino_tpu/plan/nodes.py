@@ -472,13 +472,16 @@ class PartitionedOutputNode(PlanNode):
     is rooted here hash-partitions its output rows across the consumer
     stage's tasks by ``partition_keys`` (kind="hash"); kind="gather"
     emits a single partition for a single consumer (the root stage or a
-    1-task FINAL aggregation). The partition COUNT is not part of the
+    1-task FINAL aggregation); kind="replicate" emits a single
+    partition that EVERY consumer task reads whole (the REPLICATE
+    exchange: broadcast join build sides, semi-join filtering
+    sources). The partition COUNT is not part of the
     plan — the stage scheduler fixes it at dispatch time (the consumer
     stage's task count), exactly like the reference's bucket-count
     decision living in scheduling, not in the fragment."""
     source: PlanNode
     partition_keys: Tuple[str, ...] = ()
-    kind: str = "hash"              # hash | gather
+    kind: str = "hash"              # hash | gather | replicate
 
     @property
     def sources(self):

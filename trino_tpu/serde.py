@@ -269,6 +269,18 @@ def _read_column(buf: memoryview, off: int):
                         elements), off
 
 
+def frame_valid(data: bytes) -> bool:
+    """Cheap integrity check of a serialized frame (magic prefix +
+    xxh64 trailer) WITHOUT decoding it — the exchange puller's guard
+    against accepting a non-frame HTTP 200 body (a wedged or foreign
+    endpoint) as a partition during its candidate-worker sweep."""
+    if len(data) < 12 or data[:4] != _MAGIC:
+        return False
+    buf = memoryview(data)
+    (csum,) = struct.unpack_from("<Q", buf, len(buf) - 8)
+    return checksum(bytes(buf[:-8])) == csum
+
+
 def deserialize_batch(data: bytes) -> Batch:
     buf = memoryview(data)
     body, (csum,) = buf[:-8], struct.unpack_from("<Q", buf, len(buf) - 8)

@@ -125,6 +125,12 @@ class _Task:
         self.stream_chunks = 0
         self.stream_h2d_bytes = 0
         self.done = threading.Event()
+        # coordinator-side abort (DELETE /v1/task): flips the running
+        # task's cooperative cancel — the executor stops between plan
+        # nodes and a pipelined consumer's eager exchange pull stops
+        # polling instead of spinning out remote_task_timeout against
+        # a query that already failed
+        self.cancel_ev = threading.Event()
 
     def run(self, payload: dict):
         from ..exec.hotshapes import HOT_SHAPES
@@ -133,7 +139,8 @@ class _Task:
             from ..runner import LocalQueryRunner
             from ..session import Session
             session = Session(catalog=payload.get("catalog"),
-                              schema=payload.get("schema"))
+                              schema=payload.get("schema"),
+                              cancel=self.cancel_ev)
             for name, value in payload.get("properties", {}).items():
                 session.set(name, value)
             # deadline propagation (server/coordinator.py -> exec/
@@ -191,7 +198,8 @@ class _Task:
                         stage.get("sources") or {},
                         part=int(payload["part"]), spool=self.spool,
                         timeout_s=float(
-                            session.get("remote_task_timeout")))
+                            session.get("remote_task_timeout")),
+                        cancel=self.cancel_ev)
                     ex.exchange_reader = puller.read_fragment
                     if isinstance(plan, PartitionedOutputNode):
                         body = plan.source
@@ -530,6 +538,8 @@ class TaskWorkerServer:
             t = self._tasks.pop(tid, None)
         if t is not None:
             t.state = "CANCELED"
+            t.cancel_ev.set()   # stop the running thread's executor
+            #                     and its eager exchange pulls too
             t.done.set()
             # a coordinator-side stop (cancel, deadline breach, or a
             # superseded attempt) reached THIS worker and ended a live

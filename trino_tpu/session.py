@@ -100,10 +100,26 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # route distributed queries through the stage-DAG scheduler: the
     # plan is cut at exchange points, joins/aggregations execute ON
     # WORKERS over a hash-partitioned worker-to-worker exchange, the
-    # coordinator streams only the root stage. Off by default while
-    # the flat leaf-fragment path remains the battle-tested default —
-    # plans the fragmenter declines fall back to it either way.
-    "multistage_execution": (bool, False),
+    # coordinator streams only the root stage. ON by default — the
+    # stage DAG IS the engine; the flat leaf-fragment scatter-gather
+    # path is the explicit fallback (set false to force it; plans the
+    # fragmenter declines fall back to it either way).
+    "multistage_execution": (bool, True),
+    # eager cross-stage pipelining (stage/scheduler.py): consumer
+    # stages dispatch immediately and pull committed upstream
+    # partitions WHILE their producer stage is still running (the
+    # spool's first-commit-wins frames make partial reads safe). Off =
+    # the per-stage barrier (each stage waits for all of its inputs) —
+    # kept as the A/B baseline and the conservative mode.
+    "stage_pipelining": (bool, True),
+    # lower in-slice stage exchanges to device collectives
+    # (stage/ici.py): when the whole stage DAG executes on one TPU
+    # slice (LocalQueryRunner(distributed=True) / a mesh-backed
+    # worker), the hash repartition at stage boundaries runs as
+    # jax.lax.all_to_all over ICI instead of spool+HTTP frames — only
+    # cross-host edges touch the spool. Off = mesh queries keep the
+    # node-at-a-time distributed executor (exec/distributed.py).
+    "ici_exchange": (bool, True),
     # task fan-out of intermediate (exchange-fed) stages; 0 = one task
     # per live worker (the leaf fan-out keeps following
     # hash_partition_count — reference: SystemSessionProperties

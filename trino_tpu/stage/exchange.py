@@ -10,6 +10,17 @@ frame ``p`` of that key's COMMITTED attempt — no manifest, and task
 retries/speculation dedupe through the spool's first-commit-wins
 marker exactly like any other attempt.
 
+Exchange kinds (the producing stage's PartitionedOutputNode.kind,
+recorded per source by the stage scheduler):
+
+- ``hash``: a consumer task reads frame index == its OWN partition of
+  every upstream task (co-partitioned exchange);
+- ``gather``: a single consumer task reads the single frame 0;
+- ``replicate``: EVERY consumer task reads frame 0 of every upstream
+  task — the REPLICATE exchange (broadcast build sides, semi-join
+  filtering sources: each task sees the WHOLE relation, which is what
+  makes NULL-IN semantics and cross joins partition-safe).
+
 Pull order per upstream task:
   1. the local spool (``read_frame``) — on a shared spool base
      (same-host worker fleet, or the object-store backend) this is the
@@ -18,14 +29,24 @@ Pull order per upstream task:
      mid-DAG task retry recovery work);
   2. HTTP ``GET /v1/partition/{key}/{index}`` on the worker the
      scheduler observed winning the task (server/task_worker.py) —
-     the cross-host leg when spools are not shared.
+     the cross-host leg when spools are not shared. Under eager
+     pipelining the winner is not known at consumer-dispatch time, so
+     the scheduler also ships a ``candidates`` list (every live
+     worker) and the puller sweeps it.
 
-A partition that resolves nowhere raises — the consuming ATTEMPT
-fails and the stage scheduler's retry machinery takes over.
+Eager pipelining (``eager`` in the source record): a partition that
+resolves nowhere is NOT an instant failure — the producer stage may
+simply still be running, so the puller BLOCKS, re-polling spool+HTTP
+until the frame commits, bounded by ``timeout_s``/``cancel``. The
+spool's first-commit-wins markers make these partial reads safe: only
+committed attempts are ever visible. In barrier mode (no ``eager``
+flag) an unresolvable partition raises immediately — the consuming
+ATTEMPT fails and the stage scheduler's retry machinery takes over.
 """
 
 from __future__ import annotations
 
+import time
 import urllib.request
 from typing import Dict, List, Optional
 
@@ -44,9 +65,11 @@ class ExchangePuller:
 
     ``sources`` maps stage id (as str or int — JSON stringifies dict
     keys on the wire) to ``{"tasks": [exchange keys...],
-    "uris": [winning worker base uris...]}`` as recorded by the stage
-    scheduler. ``spool`` is the caller's local spool (the worker's own,
-    or a worker-shaped spool on the coordinator) and may be None.
+    "uris": [winning worker base uris...], "kind": "hash|gather|
+    replicate", "candidates": [worker base uris...], "eager": bool}``
+    as recorded by the stage scheduler. ``spool`` is the caller's
+    local spool (the worker's own, or a worker-shaped spool on the
+    coordinator) and may be None.
     """
 
     def __init__(self, sources: Dict, part: int, spool=None,
@@ -58,34 +81,80 @@ class ExchangePuller:
         self.cancel = cancel
 
     # -- one partition frame ------------------------------------------
-    def pull_frame(self, key: str, uri: Optional[str]) -> bytes:
-        if self.cancel is not None and self.cancel.is_set():
-            raise RuntimeError(f"exchange pull of {key} canceled")
-        errors: List[str] = []
+    def _try_once(self, key: str, index: int, uris: List[str],
+                  errors: List[str], req_timeout: float
+                  ) -> Optional[bytes]:
         if self.spool is not None:
             try:
-                frame = self.spool.read_frame(key, 0, 0, self.part)
+                frame = self.spool.read_frame(key, 0, 0, index)
             except Exception as e:      # noqa: BLE001 — fall to HTTP
-                frame, errors = None, [f"spool: {type(e).__name__}: {e}"]
+                frame = None
+                errors.append(f"spool: {type(e).__name__}: {e}")
             if frame is not None:
                 return frame
-        if uri:
+        from ..serde import frame_valid
+        for uri in uris:
+            if not uri:
+                continue
             try:
                 with urllib.request.urlopen(
-                        f"{uri.rstrip('/')}/v1/partition/{key}/"
-                        f"{self.part}",
-                        timeout=max(1.0, min(self.timeout_s, 60.0))) as r:
-                    return r.read()
+                        f"{uri.rstrip('/')}/v1/partition/{key}/{index}",
+                        timeout=req_timeout) as r:
+                    frame = r.read()
+                # the candidate sweep may hit a wedged/foreign endpoint
+                # that 200s arbitrary bytes: only a structurally valid
+                # frame (magic + checksum) is an answer
+                if frame_valid(frame):
+                    return frame
+                errors.append(f"{uri}: invalid frame body")
             except Exception as e:      # noqa: BLE001
                 errors.append(f"{uri}: {type(e).__name__}: {e}")
-        raise RuntimeError(
-            f"exchange partition {self.part} of {key} unavailable"
-            + (f" ({'; '.join(errors)})" if errors else ""))
+        return None
+
+    def pull_frame(self, key: str, uri: Optional[str],
+                   index: Optional[int] = None,
+                   candidates: Optional[List[str]] = None,
+                   eager: bool = False) -> bytes:
+        """One partition frame of one upstream task. ``index`` defaults
+        to this consumer's own partition (the hash-exchange contract);
+        gather/replicate pulls pass 0. ``eager`` blocks until the frame
+        commits (pipelined mode) instead of failing the attempt."""
+        idx = self.part if index is None else int(index)
+        uris = [uri] + [c for c in (candidates or ()) if c != uri]
+        deadline = time.monotonic() + self.timeout_s
+        # start near-spin: sub-second stages commit in milliseconds,
+        # and a 20ms first sleep would hand the whole pipelining win
+        # back as added per-edge latency; back off geometrically for
+        # genuinely long producers
+        delay = 0.002
+        # eager sweeps probe with a SHORT per-request timeout: the loop
+        # re-polls anyway, and a half-dead candidate (zombie listening
+        # socket of a killed worker) must cost seconds per pass, not
+        # the whole attempt budget
+        req_timeout = (2.0 if eager
+                       else max(1.0, min(self.timeout_s, 60.0)))
+        while True:
+            if self.cancel is not None and self.cancel.is_set():
+                raise RuntimeError(f"exchange pull of {key} canceled")
+            errors: List[str] = []
+            frame = self._try_once(key, idx, uris, errors, req_timeout)
+            if frame is not None:
+                return frame
+            if not eager or time.monotonic() > deadline:
+                raise RuntimeError(
+                    f"exchange partition {idx} of {key} unavailable"
+                    + (f" ({'; '.join(errors[-3:])})" if errors else ""))
+            # the producer task may still be running: wait for its
+            # commit (the whole point of eager pipelining — consumers
+            # start before producers finish)
+            time.sleep(delay)
+            delay = min(delay * 1.6, 0.1)
 
     # -- the Executor hook (exec/executor.py _exec_RemoteSourceNode) --
     def read_fragment(self, fid: int) -> List:
-        """Deserialized batches: this task's partition of every task of
-        upstream stage ``fid``."""
+        """Deserialized batches: this task's slice of upstream stage
+        ``fid`` — its own partition of every task (hash), or the whole
+        output (gather/replicate)."""
         from ..serde import deserialize_batch
         src = self.sources.get(str(fid))
         if src is None:
@@ -94,9 +163,14 @@ class ExchangePuller:
         tasks = list(src.get("tasks") or ())
         uris = list(src.get("uris") or ())
         uris += [None] * (len(tasks) - len(uris))
+        kind = str(src.get("kind") or "hash")
+        candidates = list(src.get("candidates") or ())
+        eager = bool(src.get("eager"))
+        index = 0 if kind in ("gather", "replicate") else None
         out, nbytes = [], 0
         for key, uri in zip(tasks, uris):
-            frame = self.pull_frame(key, uri)
+            frame = self.pull_frame(key, uri, index=index,
+                                    candidates=candidates, eager=eager)
             nbytes += len(frame)
             out.append(deserialize_batch(frame))
         EXCHANGE_PARTITIONS.inc(len(out), direction="read")

@@ -17,18 +17,26 @@ row-local shell):
   equi-clause keys (hash-partitioned join — both sides repartition by
   their key columns, equal values colocate);
 - **aggregation**: FINAL (combinable kinds, avg split into sum+count)
-  or SINGLE (holistic kinds — the rows themselves repartition by the
-  group keys so every group is complete per task);
+  or SINGLE (holistic kinds and grouping sets — the rows themselves
+  repartition by the group keys, including the grouping-set id, so
+  every group is complete per task);
 - **window**: partition_by-keyed repartition, window per task;
+- **semi join**: the filtering source becomes a REPLICATE stage — every
+  consumer task reads the WHOLE filtering relation, so SQL's NULL-IN
+  semantics (a non-matching probe row's verdict depends on whether the
+  filtering side contains a NULL *anywhere*) hold per task; this is
+  the replicate-nulls-and-any partitioning collapsed to full
+  replication. The probe side stays INLINE (colocated with its scan
+  chain) — no probe-side exchange hop;
+- **cross / replicated join**: joins without equi-criteria replicate
+  the right side; equi-joins the optimizer marked REPLICATED
+  (broadcast distribution) do the same, keeping the probe-side scan
+  chain inline in the join stage — an exchange hop deleted outright;
 - **values**: a single-task stage (inlining VALUES into a split-shared
   stage would duplicate its rows once per task).
 
 Anything else raises ``_Fallback`` and ``fragment`` returns None — the
-caller keeps the flat leaf-fragment path (exec/remote.py). Notably
-semi joins stay on the fallback path: SQL's NULL-IN semantics make a
-non-matching probe row's verdict depend on whether the filtering side
-contains a NULL *anywhere*, which hash co-partitioning cannot see
-without the reference's replicate-nulls-and-any partitioning.
+caller falls back to the flat leaf-fragment path (exec/remote.py).
 """
 
 from __future__ import annotations
@@ -37,12 +45,13 @@ from dataclasses import dataclass, replace as dc_replace
 from typing import Dict, List, Optional, Tuple
 
 from ..plan.nodes import (Aggregate, AggregationNode,
-                          EnforceSingleRowNode, FilterNode, JoinNode,
-                          LimitNode, OffsetNode, OutputNode,
+                          EnforceSingleRowNode, FilterNode, GroupIdNode,
+                          JoinNode, LimitNode, OffsetNode, OutputNode,
                           PartitionedOutputNode, PlanNode, ProjectNode,
-                          RemoteSourceNode, SortNode, TableScanNode,
-                          TopNNode, UnionNode, UnnestNode, ValuesNode,
-                          WindowNode)
+                          RemoteSourceNode, SemiJoinNode, SortNode,
+                          TableScanNode, TopNNode, UnionNode, UnnestNode,
+                          ValuesNode, WindowNode)
+from ..planner.logical import SemiJoinMultiNode
 from ..rex import Call, InputRef
 from ..types import BIGINT, DecimalType
 
@@ -208,7 +217,7 @@ class StageFragmenter:
 
     # -- entry ---------------------------------------------------------
     def fragment(self, plan: PlanNode) -> Optional[StageDAG]:
-        self.stages = []
+        self.stages = []  # tt-lint: ignore[race-attr-write] a fragmenter instance is created and consumed by ONE thread per fragment() call
         try:
             shell: List[PlanNode] = []
             node = plan
@@ -256,8 +265,8 @@ class StageFragmenter:
                                                  out_kind),
                       tuple(ctx.inputs), None, ctx.max_tasks)
         for i in ctx.inputs:
-            self.stages[i].consumer = sid
-        self.stages.append(stage)
+            self.stages[i].consumer = sid  # tt-lint: ignore[race-attr-write] fragmenter state is single-threaded (one thread per fragment() call)
+        self.stages.append(stage)  # tt-lint: ignore[race-attr-mutate] fragmenter state is single-threaded (one thread per fragment() call)
         return sid
 
     # -- distribution predicates --------------------------------------
@@ -276,10 +285,13 @@ class StageFragmenter:
     def _scan_subtree(self, node: PlanNode) -> bool:
         """Source-distributed subtree: executable per split share with
         the shares unioning to the full output (scan chains and unions
-        of scan chains; every row-local node in between is fine)."""
+        of scan chains; every row-local node in between is fine —
+        GroupIdNode replicates rows split-locally, so the shares still
+        union to the full grouping-set expansion)."""
         if isinstance(node, TableScanNode):
             return self._remotable_scan(node)
-        if isinstance(node, (FilterNode, ProjectNode, UnnestNode)):
+        if isinstance(node, (FilterNode, ProjectNode, UnnestNode,
+                             GroupIdNode)):
             return self._scan_subtree(node.source)
         if isinstance(node, UnionNode):
             return all(self._scan_subtree(c) for c in node.children)
@@ -304,11 +316,14 @@ class StageFragmenter:
             # only in a single-task stage
             ctx.max_tasks = 1
             return node
-        if isinstance(node, (FilterNode, ProjectNode, UnnestNode)):
+        if isinstance(node, (FilterNode, ProjectNode, UnnestNode,
+                             GroupIdNode)):
             return dc_replace(node,
                               source=self._build_body(node.source, ctx))
         if isinstance(node, JoinNode):
             return self._join_body(node, ctx)
+        if isinstance(node, (SemiJoinNode, SemiJoinMultiNode)):
+            return self._semi_join_body(node, ctx)
         if isinstance(node, AggregationNode):
             return self._aggregation_body(node, ctx)
         if isinstance(node, WindowNode) and node.partition_by:
@@ -319,10 +334,52 @@ class StageFragmenter:
                 (sid,), node.source.output_schema()))
         raise _Fallback(type(node).__name__)
 
+    def _replicate_input(self, node: PlanNode,
+                         ctx: _Ctx) -> RemoteSourceNode:
+        """Cut ``node`` into a REPLICATE stage: every task of the
+        consuming stage reads its whole output (the reference's
+        REPLICATE exchange / BroadcastOutputBuffer)."""
+        sid = self._stage(node, "replicate", ())
+        ctx.inputs.append(sid)
+        return RemoteSourceNode((sid,), node.output_schema(),
+                                "replicate")
+
+    def _semi_join_body(self, node, ctx: _Ctx) -> PlanNode:
+        """Semi join: the filtering source replicates WHOLE to every
+        task, so each task sees any filtering-side NULL anywhere and
+        NULL-IN semantics hold per task (the replicate-nulls-and-any
+        partitioning, collapsed to full replication). The probe side
+        stays inline — colocated with its scan chain, no probe
+        exchange hop."""
+        filt = self._replicate_input(node.filtering_source, ctx)
+        src = self._build_body(node.source, ctx)
+        return dc_replace(node, source=src, filtering_source=filt)
+
     def _join_body(self, node: JoinNode, ctx: _Ctx) -> PlanNode:
         if not node.criteria:
-            raise _Fallback("join without equi-criteria (cross/filter "
-                            "joins need a replicate exchange)")
+            # cross / filter-only join: replicate the build (right)
+            # side, keep the probe inline. Sound only when each task
+            # owns its probe rows exclusively — inner/cross always,
+            # LEFT because unmatched-probe preservation is probe-local;
+            # right/full would preserve the REPLICATED side once per
+            # task (duplicates), so they stay on the fallback path.
+            if node.join_type not in ("inner", "cross", "left"):
+                raise _Fallback(
+                    f"{node.join_type} join without equi-criteria")
+            right = self._replicate_input(node.right, ctx)
+            left = self._build_body(node.left, ctx)
+            return dc_replace(node, left=left, right=right)
+        if (str(node.distribution or "").lower() == "replicated"
+                and node.join_type in ("inner", "left")):
+            # REPLICATED (broadcast) distribution, chosen by the
+            # optimizer's size heuristic: the build side replicates to
+            # every task and the probe-side scan chain stays INLINE in
+            # this stage — the probe-side exchange hop is deleted
+            # outright (reference: AddExchanges' REPLICATED branch
+            # keeps the probe source-distributed)
+            right = self._replicate_input(node.right, ctx)
+            left = self._build_body(node.left, ctx)
+            return dc_replace(node, left=left, right=right)
         lkeys = tuple(c.left for c in node.criteria)
         rkeys = tuple(c.right for c in node.criteria)
         # co-partitioned hash join: both sides repartition on their
@@ -342,9 +399,16 @@ class StageFragmenter:
 
     def _aggregation_body(self, node: AggregationNode,
                           ctx: _Ctx) -> PlanNode:
-        if node.step != "SINGLE" or node.group_id_symbol is not None:
-            raise _Fallback("non-SINGLE / grouping-set aggregation")
-        combinable = splittable_aggregates(node)
+        if node.step != "SINGLE":
+            raise _Fallback("non-SINGLE aggregation")
+        # grouping sets distribute like holistic kinds: the group keys
+        # include the grouping-set id (planner/logical.py appends it),
+        # and GroupIdNode's expansion runs split-locally below, so a
+        # hash repartition on the full key tuple colocates every
+        # (key values, set id) group — NULLed key lanes of subtotal
+        # copies hash identically on every worker (NULL -> 0)
+        combinable = (splittable_aggregates(node)
+                      and node.group_id_symbol is None)
         gk = tuple(node.group_keys)
         if gk and combinable:
             # PARTIAL fused into the producer stage (above its join /

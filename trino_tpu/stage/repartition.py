@@ -165,10 +165,14 @@ def partition_frames(batch: Batch, keys: Sequence[str], kind: str,
     partition i (one frame per partition — the deterministic layout the
     exchange contract requires; a consumer reads frame index
     == its own partition). kind="gather" (or nparts==1) emits the whole
-    batch as the single partition."""
+    batch as the single partition; kind="replicate" does the same on
+    the producing side — the REPLICATE semantics live in the consumer
+    (stage/exchange.py), where EVERY task reads frame 0 instead of its
+    own partition index, so the bytes are spooled once, not once per
+    consumer task."""
     from ..serde import serialize_batch
     n = batch.num_rows_host()
-    if kind == "gather" or nparts <= 1:
+    if kind in ("gather", "replicate") or nparts <= 1:
         host = Batch({s: _host_col(c)
                       for s, c in batch.columns.items()}, n)
         parts = [_take_rows(host, np.arange(n, dtype=np.int64), n)]

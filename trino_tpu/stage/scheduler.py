@@ -12,10 +12,30 @@ marker arbitrates duplicate attempts per-stage for free, and a task
 retried after its worker died re-pulls its upstream partitions off the
 spool (stage/exchange.py).
 
-Scheduling is stage-by-stage with a barrier (the DAG arrives in
-topological order from the fragmenter; eager cross-stage pipelining is
-a follow-on — correctness first, the exchange layout already permits
-it since consumers address committed frames only).
+Two scheduling modes (``stage_pipelining`` session property):
+
+- **eager pipelining** (default): every stage's tasks dispatch
+  IMMEDIATELY, in topological order but without barriers. A consumer
+  task's exchange puller blocks per upstream partition until the
+  producing task COMMITS it (stage/exchange.py eager mode) — the
+  spool's first-commit-wins frames make these partial reads safe, so
+  a consumer starts joining/aggregating the moment its first upstream
+  task lands while sibling producers are still running. Source
+  records publish up front with winner URIs filled in as tasks
+  complete; a ``candidates`` list (every live worker) covers the
+  cross-host pull before a winner is known.
+- **per-stage barrier** (``stage_pipelining=false``): the pre-PR-13
+  behavior — a stage dispatches only after every input stage fully
+  committed. Kept as the conservative mode and the bench A/B baseline.
+
+The pipelining overlap (share of exchange wall time where >= 2 stages
+had tasks in flight) is recorded per query in
+``trino_tpu_mpp_pipeline_overlap_ratio``.
+
+A permanently failed task aborts the whole DAG run: the execution-wide
+``abort`` event cancels sibling stages' in-flight waits (without
+blaming their workers) so a pipelined consumer never spins out its
+full timeout against a producer that can no longer commit.
 """
 
 from __future__ import annotations
@@ -30,14 +50,16 @@ from ..fte.retry import (TASK_RETRIES, RetryController, RetryPolicy,
                          backoff_delay, pick_worker)
 from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
                              StragglerDetector)
-from ..obs.metrics import STAGES_SCHEDULED
+from ..obs.metrics import MPP_OVERLAP_RATIO, STAGES_SCHEDULED
+from ..plan.nodes import PlanNode, TableScanNode
 from .exchange import exchange_task_key
 from .fragmenter import Stage, StageDAG
 
 
 class _Watch:
     """``is_set()`` ORs several events — aborts a status poll the
-    moment a sibling attempt wins or the user cancels."""
+    moment a sibling attempt wins, the DAG run fails elsewhere, or the
+    user cancels."""
 
     __slots__ = ("_events",)
 
@@ -46,6 +68,19 @@ class _Watch:
 
     def is_set(self) -> bool:
         return any(e.is_set() for e in self._events)
+
+
+def _plan_has_scan(plan: PlanNode) -> bool:
+    """True when a stage body reads table splits (its fan-out follows
+    the leaf policy even when it also consumes exchange inputs — the
+    colocated scan+join shape)."""
+    stack = [plan]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, TableScanNode):
+            return True
+        stack.extend(n.sources)
+    return False
 
 
 class _STask:
@@ -78,6 +113,19 @@ class _STask:
             return attempt
 
 
+class _StageRun:
+    """One launched stage's in-flight state (tasks + telemetry sinks),
+    handed from ``_launch_stage`` to ``_await_stage``."""
+
+    __slots__ = ("stage", "tasks", "worker_stats", "stop_ev")
+
+    def __init__(self, stage: Stage, tasks: List[_STask]):
+        self.stage = stage
+        self.tasks = tasks
+        self.worker_stats: List[List[NodeStats]] = []
+        self.stop_ev = threading.Event()
+
+
 class StageExecution:
     """Runs every worker stage of a DAG for one query; the caller
     (exec/remote.py RemoteScheduler) then executes the root plan on
@@ -100,10 +148,20 @@ class StageExecution:
         self.speculation_on = bool(
             session.get("speculation_enabled")) \
             and len(scheduler.workers) > 1
-        # sid -> {"tasks": [exchange keys], "uris": [winner uris]}
+        self.pipelined = bool(session.get("stage_pipelining"))
+        # execution-wide abort: set when any stage fails permanently,
+        # unblocking sibling stages' waits and eager exchange pulls
+        self.abort = threading.Event()
+        # sid -> {"tasks": [exchange keys], "uris": [winner uris],
+        #         "kind": .., "candidates": [..], "eager": bool} —
+        # published up front; task threads fill uris[part] at win time
         self.sources: Dict[int, dict] = {}
         self.ntasks: Dict[int, int] = {}
         self._assign_task_counts()
+        # winning-attempt wall windows (sid, t0, t1) for the pipelining
+        # overlap rollup; guarded by the scheduler's stats lock
+        self._windows: List[Tuple[int, float, float]] = []
+        self.overlap_ratio: float = 0.0
         # per-stage telemetry for the EXPLAIN ANALYZE rollup
         # (sid -> MERGED per-node stats across the stage's tasks)
         self.stage_stats: Dict[int, List[NodeStats]] = {}
@@ -114,17 +172,19 @@ class StageExecution:
     def _assign_task_counts(self) -> None:
         """Fix every stage's task fan-out up front (a stage's OUTPUT
         partition count is its consumer's task count — the bucket-count
-        decision the plan deliberately does not carry). Leaf fan-out
-        follows hash_partition_count like the flat path; intermediate
-        stages follow exchange_partition_count; a stage fed by a
-        gather exchange runs exactly one task (it consumes the single
-        gathered partition)."""
+        decision the plan deliberately does not carry). Split-reading
+        stages (a plain leaf, or a colocated scan+join stage that also
+        consumes a replicate input) follow hash_partition_count like
+        the flat path; exchange-only stages follow
+        exchange_partition_count; a stage fed by a gather exchange runs
+        exactly one task (it consumes the single gathered
+        partition)."""
         session = self.s.session
         nworkers = len(self.s.workers)
         hpc = int(session.get("hash_partition_count"))
         epc = int(session.get("exchange_partition_count"))
         for st in self.dag.stages:
-            if not st.inputs:
+            if not st.inputs or _plan_has_scan(st.plan):
                 n = min(nworkers, hpc) if hpc > 0 else nworkers
             else:
                 n = epc if epc > 0 else nworkers
@@ -140,30 +200,118 @@ class StageExecution:
             return 1                    # the coordinator's root gather
         return self.ntasks[stage.consumer]
 
+    # -- source records -----------------------------------------------
+    def _publish_sources(self) -> None:
+        """Pre-publish every stage's exchange record. Task threads fill
+        ``uris[part]`` as winners land; under the barrier every uri is
+        set before any consumer dispatches, under pipelining the
+        ``candidates`` sweep covers the not-yet-known winners."""
+        candidates = [c.base_uri for c in self.s.workers]
+        for st in self.dag.stages:
+            n = self.ntasks[st.sid]
+            self.sources[st.sid] = {  # tt-lint: ignore[race-attr-write] published by the driver thread BEFORE any task thread launches; task threads only assign uris slots
+                "tasks": [exchange_task_key(self.qid, st.sid, p)
+                          for p in range(n)],
+                "uris": [None] * n,
+                "kind": st.output_node.kind,
+                "candidates": candidates,
+                "eager": self.pipelined}
+
+    def _snapshot_sources(self, stage: Stage) -> Dict[str, dict]:
+        """Per-attempt copy of the input stages' records (the uris
+        list mutates as winners land — a submit must ship a stable
+        snapshot)."""
+        out: Dict[str, dict] = {}
+        for i in stage.inputs:
+            src = self.sources[i]
+            out[str(i)] = {"tasks": list(src["tasks"]),
+                           "uris": list(src["uris"]),
+                           "kind": src["kind"],
+                           "candidates": list(src["candidates"]),
+                           "eager": src["eager"]}
+        return out
+
+    # -- overlap rollup ------------------------------------------------
+    def _compute_overlap(self) -> float:
+        """Share of covered wall time where tasks of >= 2 DIFFERENT
+        stages ran concurrently — 0 under the barrier, the pipelining
+        win when > 0."""
+        with self.s._stats_lock:
+            windows = list(self._windows)
+        if not windows:
+            return 0.0
+        events: List[Tuple[float, int, int]] = []
+        for sid, t0, t1 in windows:
+            if t1 > t0:
+                events.append((t0, 1, sid))
+                events.append((t1, -1, sid))
+        if not events:
+            return 0.0
+        events.sort(key=lambda e: e[0])
+        live: Dict[int, int] = {}
+        covered = multi = 0.0
+        prev = events[0][0]
+        for t, delta, sid in events:
+            nstages = sum(1 for v in live.values() if v > 0)
+            if t > prev and nstages > 0:
+                covered += t - prev
+                if nstages > 1:
+                    multi += t - prev
+            live[sid] = live.get(sid, 0) + delta
+            prev = t
+        return (multi / covered) if covered > 0 else 0.0
+
     # -- the run -------------------------------------------------------
     def run(self) -> Dict[int, dict]:
-        for stage in self.dag.stages:
-            # deadline propagation: no stage is dispatched past the
-            # query's wall-clock budget (the per-attempt waits below
-            # are bounded by the same shrinking remainder)
-            self.s._check_deadline(f"stage {stage.sid} dispatch")
-            self._run_stage(stage)
+        self._publish_sources()
+        if self.pipelined:
+            self._run_pipelined()
+        else:
+            for stage in self.dag.stages:
+                # deadline propagation: no stage is dispatched past the
+                # query's wall-clock budget (the per-attempt waits
+                # below are bounded by the same shrinking remainder)
+                self.s._check_deadline(f"stage {stage.sid} dispatch")
+                self._await_stage(self._launch_stage(stage))
+        self.overlap_ratio = self._compute_overlap()  # tt-lint: ignore[race-attr-write] driver-thread-only, written after every stage's tasks completed
+        MPP_OVERLAP_RATIO.set(self.overlap_ratio)
         return self.sources
 
-    def _run_stage(self, stage: Stage) -> None:
+    def _run_pipelined(self) -> None:
+        """Eager mode: launch every stage now (topological order, no
+        barrier); consumers block inside their exchange pulls until
+        upstream partitions commit. Awaiting still walks producers
+        first, so per-stage telemetry lands in DAG order; a failure
+        aborts the remaining stages' waits."""
+        runs: List[_StageRun] = []
+        self.s._check_deadline("stage-DAG dispatch")
+        for stage in self.dag.stages:
+            runs.append(self._launch_stage(stage))
+        first_err: Optional[BaseException] = None
+        for sr in runs:
+            try:
+                self._await_stage(sr)
+            except BaseException as e:  # noqa: BLE001 — propagate the
+                # FIRST failure after unblocking every sibling stage
+                self.abort.set()
+                if first_err is None:
+                    first_err = e
+        if first_err is not None:
+            raise first_err
+
+    def _launch_stage(self, stage: Stage) -> _StageRun:
         s = self.s
         session = s.session
         sid = stage.sid
         ntasks = self.ntasks[sid]
         nout = self._nparts_out(stage)
         STAGES_SCHEDULED.inc()
-        stage_sources = {str(i): self.sources[i] for i in stage.inputs}
         tasks = [_STask(sid, part,
                         exchange_task_key(self.qid, sid, part))
                  for part in range(ntasks)]
+        sr = _StageRun(stage, tasks)
         trace = getattr(session, "trace", None)
         trace_parent = trace.current() if trace is not None else None
-        worker_stats: List[List[NodeStats]] = []
         timeout_s = float(session.get("remote_task_timeout"))
 
         def alive(wi: int) -> bool:
@@ -192,9 +340,9 @@ class StageExecution:
                     deadline_s=s._remaining_s(),
                     stage={"sid": sid, "exchange_key": st.key,
                            "nparts_out": nout,
-                           "sources": stage_sources})
+                           "sources": self._snapshot_sources(stage)})
                 watch = _Watch(getattr(session, "cancel", None),
-                               st.done)
+                               st.done, self.abort)
                 status = client.wait_done(
                     tid, cancel=watch,
                     timeout_s=s._attempt_budget_s(timeout_s))
@@ -214,6 +362,12 @@ class StageExecution:
                 cancel = getattr(session, "cancel", None)
                 if cancel is not None and cancel.is_set():
                     return f"stage {sid} fragment task {tid}: canceled"
+                if self.abort.is_set():
+                    # the DAG already failed elsewhere: this abort is
+                    # not evidence against THIS worker — no detector
+                    # demerit, no exclusion
+                    return (f"stage {sid} fragment task {tid}: aborted "
+                            "(query failed in another stage)")
                 if s.failure_detector is not None:
                     s.failure_detector.record_task_failure(
                         client.base_uri, f"{type(e).__name__}: {e}")
@@ -233,6 +387,10 @@ class StageExecution:
             if not won:
                 return None     # duplicate output: the spool's
                 #                 first-commit-wins already discarded it
+            # publish the winner uri for consumers dispatched from now
+            # on (pipelined consumers already in flight sweep the
+            # candidates list instead)
+            self.sources[sid]["uris"][st.part] = client.base_uri  # tt-lint: ignore[race-attr-write] slot-exclusive: one winner per part, list item assignment is atomic
             # the winner MUST set st.done (finally): a crash in the
             # best-effort telemetry would strand the untimed stage wait
             try:
@@ -249,6 +407,7 @@ class StageExecution:
                         status.get("streamChunks") or 0)
                     s.stream_h2d_bytes += int(
                         status.get("streamH2dBytes") or 0)
+                    self._windows.append((sid, t0, t1))
                 if speculative:
                     with s._stats_lock:
                         s.speculative_wins += 1
@@ -257,7 +416,7 @@ class StageExecution:
                     reported = [NodeStats.from_dict(d) for d in
                                 status.get("nodeStats") or []]
                     if reported:
-                        worker_stats.append(reported)
+                        sr.worker_stats.append(reported)
                     with s._stats_lock:
                         self.resources.append((
                             int(status.get("peakMemoryBytes") or 0),
@@ -295,7 +454,8 @@ class StageExecution:
                 failures += 1
                 st.errors.append(err)
                 cancel = getattr(session, "cancel", None)
-                canceled = cancel is not None and cancel.is_set()
+                canceled = (cancel is not None and cancel.is_set()) \
+                    or self.abort.is_set()
                 rem = s._remaining_s()
                 if rem is not None and rem <= 0:
                     canceled = True     # deadline outranks the budget
@@ -343,8 +503,8 @@ class StageExecution:
             finally:
                 st.spec_done.set()
 
-        def monitor(stop_ev: threading.Event) -> None:
-            while not stop_ev.wait(0.05):
+        def monitor() -> None:
+            while not sr.stop_ev.wait(0.05):
                 pending = [st for st in tasks if not st.done.is_set()]
                 if not pending:
                     return
@@ -395,27 +555,25 @@ class StageExecution:
         for st in tasks:
             threading.Thread(target=run_task, args=(st,),
                              daemon=True).start()
-        stop_ev = threading.Event()
         if self.speculation_on:
-            threading.Thread(target=monitor, args=(stop_ev,),
-                             daemon=True).start()
+            threading.Thread(target=monitor, daemon=True).start()
+        return sr
+
+    def _await_stage(self, sr: _StageRun) -> None:
+        s = self.s
+        sid = sr.stage.sid
         try:
-            for st in tasks:
+            for st in sr.tasks:
                 st.done.wait()
         finally:
-            stop_ev.set()
-        failed = [st for st in tasks if st.failed]
+            sr.stop_ev.set()
+        failed = [st for st in sr.tasks if st.failed]
         if failed:
             from ..exec.executor import QueryError
             raise QueryError(
                 "remote task failed: " + "; ".join(
                     "; ".join(st.errors[-2:]) for st in failed[:3]))
-        self.sources[sid] = {  # tt-lint: ignore[race-attr-write] DAG-level maps are driver-thread-only: written between stage barriers, task threads never touch them
-            "tasks": [st.key for st in tasks],
-            "uris": [s.workers[st.winner[1]].base_uri
-                     if st.winner is not None else None
-                     for st in tasks]}
         if s.collect_stats:
             from ..exec.executor import merge_node_stats
-            self.stage_stats[sid] = merge_node_stats(worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage barrier
-            self.stage_reported[sid] = len(worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage barrier
+            self.stage_stats[sid] = merge_node_stats(sr.worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage's tasks completed
+            self.stage_reported[sid] = len(sr.worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage's tasks completed
