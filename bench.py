@@ -593,6 +593,126 @@ def _leg_load(duration_s: float, clients: int) -> dict:
     })
 
 
+def _leg_load_mixed(duration_s: float, clients: int) -> dict:
+    """Mixed-size load leg (ISSUE 14 acceptance): K >> runner-threads
+    concurrent clients — half small point queries, half large joins —
+    against ONE worker whose shared split scheduler (exec/taskexec.py)
+    time-slices every query's tasks through 2 runner slots. Reports
+    the small queries' p95 vs their ISOLATED latency (the acceptance
+    bound: within 3x at K >> runners — without the fair scheduler a
+    large query owns the worker and small-query latency balloons) and
+    a starvation/fairness metric (min/max completed across the small
+    clients; 1.0 = perfectly fair, 0 = a client starved)."""
+    import threading
+
+    import trino_tpu  # noqa: F401
+    from trino_tpu.client import ClientError, StatementClient
+    from trino_tpu.obs.metrics import METRICS
+    from trino_tpu.server.coordinator import Coordinator
+    from trino_tpu.server.task_worker import TaskWorkerServer
+
+    RUNNERS = 2
+    worker = TaskWorkerServer(task_runners=RUNNERS).start()
+    # no admission cap: this leg measures WORKER-side fairness, so
+    # every client's query must actually reach the worker at once
+    co = Coordinator(worker_uris=[worker.base_uri],
+                     memory_pool_bytes=4 << 30).start()
+    small_sql = "SELECT count(*) FROM tpch.tiny.region"
+    # the large shape is scan-heavy (chunkable end to end): forced
+    # chunking below turns every chunk into a scheduler yield point
+    large_sql = ("SELECT l_returnflag, count(*), "
+                 "sum(l_extendedprice * (1 - l_discount)), "
+                 "avg(l_quantity) FROM tpch.tiny.lineitem "
+                 "WHERE l_shipdate <= DATE '1998-09-02' "
+                 "GROUP BY l_returnflag ORDER BY l_returnflag")
+    warm_client = StatementClient(co.base_uri)
+    cold_s, warm_s = _cold_warm(
+        lambda: (warm_client.execute(small_sql),
+                 warm_client.execute(large_sql)), 1)
+    # isolated small-query latency (warm, no contention): the
+    # denominator of the acceptance ratio
+    iso = []
+    for _ in range(5):
+        t0 = time.monotonic()
+        warm_client.execute(small_sql)
+        iso.append(time.monotonic() - t0)
+    iso_p50 = sorted(iso)[len(iso) // 2]
+    n_small = max(clients // 2, 1)
+    lats: list = [[] for _ in range(clients)]
+    completed = [0] * clients
+    yields0 = METRICS.counter(
+        "trino_tpu_task_scheduler_yields_total").value()
+    stop_at = time.monotonic() + duration_s
+
+    errors = [0] * clients
+
+    def run(i: int):
+        # large clients force chunked execution (stream_chunk_rows):
+        # every chunk is a scheduler yield point, so a large query
+        # cannot own a runner slot for a whole operator — the quanta
+        # the small queries' latency bound depends on
+        props = ({} if i < n_small
+                 else {"stream_chunk_rows": "4096"})
+        props["retry_policy"] = "TASK"
+        c = StatementClient(co.base_uri, session_properties=props)
+        sql = small_sql if i < n_small else large_sql
+        while time.monotonic() < stop_at:
+            t0 = time.monotonic()
+            try:
+                c.execute(sql)
+            except ClientError:
+                # transient under churn (connection resets on the
+                # threaded HTTP stack): counted, never a dead client
+                errors[i] += 1
+                continue
+            lats[i].append(time.monotonic() - t0)
+            completed[i] += 1
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    co.stop()
+    worker.stop()
+    small_lats = sorted(x for i in range(n_small) for x in lats[i])
+    large_lats = sorted(x for i in range(n_small, clients)
+                        for x in lats[i])
+
+    def pct(sorted_xs, q):
+        if not sorted_xs:
+            return 0.0
+        return sorted_xs[min(int(q * len(sorted_xs)),
+                             len(sorted_xs) - 1)]
+
+    small_counts = completed[:n_small]
+    fairness = (min(small_counts) / max(small_counts)
+                if max(small_counts) else 0.0)
+    p95 = pct(small_lats, 0.95)
+    return dict(_cw_keys(cold_s, warm_s), **{
+        "mixed_qps": sum(completed) / max(elapsed, 1e-9),
+        "clients": clients,
+        "runner_threads": RUNNERS,
+        "duration_s": round(elapsed, 2),
+        "small_completed": sum(small_counts),
+        "large_completed": sum(completed[n_small:]),
+        "small_p50_ms": round(pct(small_lats, 0.50) * 1000, 2),
+        "small_p95_ms": round(p95 * 1000, 2),
+        "large_p95_ms": round(pct(large_lats, 0.95) * 1000, 2),
+        "isolated_small_p50_ms": round(iso_p50 * 1000, 2),
+        # the acceptance ratio: <= 3.0 means small queries held their
+        # latency next to the large ones at K >> runner threads
+        "small_p95_vs_isolated": round(p95 / max(iso_p50, 1e-9), 2),
+        "fairness_min_over_max": round(fairness, 3),
+        "client_errors": sum(errors),
+        "scheduler_yields": METRICS.counter(
+            "trino_tpu_task_scheduler_yields_total").value() - yields0,
+    })
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -632,7 +752,8 @@ def _run_probe_body(kind: str):
                 ("telemetry", lambda: _leg_telemetry("sf1", 2)),
                 ("fault", lambda: _leg_fault(2)),
                 ("mpp", lambda: _leg_mpp(2)),
-                ("load", lambda: _leg_load(6.0, 6))]
+                ("load", lambda: _leg_load(6.0, 6)),
+                ("load_mixed", lambda: _leg_load_mixed(6.0, 8))]
     for name, fn in legs:
         try:
             # every leg returns a dict carrying (at least) compile_s +
@@ -703,6 +824,15 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False):
                 errs["init"] = ("no accelerator: platform="
                                 f"{d.get('platform')} x"
                                 f"{d.get('device_count')}")
+        elif leg == "load_mixed" and "mixed_qps" in d:
+            # mixed-size load ride-alongs: worker-side fairness
+            vals["load_mixed"] = d["mixed_qps"]
+            for k in ("small_p50_ms", "small_p95_ms", "large_p95_ms",
+                      "isolated_small_p50_ms", "small_p95_vs_isolated",
+                      "fairness_min_over_max", "small_completed",
+                      "large_completed", "scheduler_yields"):
+                if k in d:
+                    vals[f"load_mixed_{k}"] = d[k]
         elif "qps" in d:
             # load leg ride-alongs: the concurrency scoreboard
             vals["load"] = d["qps"]
@@ -748,7 +878,8 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False):
     expected = ("init",) if kind == "init" else \
         ("q18",) if kind == "scale" else \
         ("engine", "warm", "micro", "telemetry") + \
-        (("fault", "mpp", "load") if kind == "cpu" else ())
+        (("fault", "mpp", "load", "load_mixed")
+         if kind == "cpu" else ())
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -961,6 +1092,29 @@ def main():
             cpu_vals.get("load_rejections", 0.0) or 0.0, 1),
         "load_memory_kills": round(
             cpu_vals.get("load_memory_kills", 0.0) or 0.0, 1),
+        # worker-side multi-query runtime (exec/taskexec.py, ISSUE 14):
+        # mixed-size closed loop — K=8 clients (half small point
+        # queries, half large joins) over ONE worker with 2 runner
+        # slots. The acceptance bound is small_p95_vs_isolated <= 3.0
+        # (small queries hold their latency at K >> runner threads);
+        # fairness is min/max completed across the small clients
+        "load_mixed_qps": round(
+            cpu_vals.get("load_mixed", 0.0) or 0.0, 2),
+        "load_mixed_small_p95_ms": round(
+            cpu_vals.get("load_mixed_small_p95_ms", 0.0) or 0.0, 2),
+        "load_mixed_small_p95_vs_isolated": round(
+            cpu_vals.get("load_mixed_small_p95_vs_isolated", 0.0)
+            or 0.0, 2),
+        "load_mixed_isolated_small_p50_ms": round(
+            cpu_vals.get("load_mixed_isolated_small_p50_ms", 0.0)
+            or 0.0, 2),
+        "load_mixed_large_p95_ms": round(
+            cpu_vals.get("load_mixed_large_p95_ms", 0.0) or 0.0, 2),
+        "load_mixed_fairness_min_over_max": round(
+            cpu_vals.get("load_mixed_fairness_min_over_max", 0.0)
+            or 0.0, 3),
+        "load_mixed_scheduler_yields": round(
+            cpu_vals.get("load_mixed_scheduler_yields", 0.0) or 0.0, 1),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale, now through the

@@ -493,6 +493,91 @@ def test_commit_linked_hard_links_single_write(tmp_path):
     assert coord.read("q2", 0, 0) == frames
 
 
+def _kill_server(worker) -> None:
+    """shutdown + close on a background thread: connections REFUSE
+    immediately (a dead process), not a zombie listening socket."""
+    def stop():
+        worker._httpd.shutdown()
+        worker._httpd.server_close()
+    threading.Thread(target=stop, daemon=True).start()
+
+
+class _DiesOnMidDagTask(TaskWorkerServer):
+    """Executes leaf tasks normally, then dies the first time it
+    receives an exchange-fed task — mid-flight, while other queries'
+    tasks are interleaving on the surviving workers."""
+
+    def create_task(self, tid, payload):
+        stage = payload.get("stage") or {}
+        if stage.get("sources") and not getattr(self, "_killed",
+                                                False):
+            self._killed = True
+            _kill_server(self)
+            raise ConnectionResetError("killed mid-interleave")
+        return super().create_task(tid, payload)
+
+
+def test_worker_kill_during_shared_scheduler_interleaving():
+    """ISSUE 14 chaos: a worker dies while the shared split scheduler
+    (exec/taskexec.py) is interleaving >= 2 concurrent queries on
+    1-runner-slot survivors — both queries complete with exact
+    results, and the victim's tasks are rescheduled through the
+    normal per-stage retry machinery."""
+    sql2 = ("SELECT r_name, count(*) FROM region "
+            "GROUP BY r_name ORDER BY r_name")
+    exp = {
+        "q1": LocalQueryRunner(session=Session(
+            catalog="tpch", schema="tiny")).execute(SQL).rows,
+        "q2": LocalQueryRunner(session=Session(
+            catalog="tpch", schema="tiny")).execute(sql2).rows,
+    }
+    bad = _DiesOnMidDagTask().start()
+    # ONE runner slot each: concurrent queries' tasks genuinely
+    # time-slice through the multilevel queue instead of running on
+    # parallel threads
+    w1 = TaskWorkerServer(task_runners=1).start()
+    w2 = TaskWorkerServer(task_runners=1).start()
+    retries_before = _counter("trino_tpu_task_retries_total")
+    results, errs = {}, []
+
+    def run(name, sql):
+        try:
+            results[name] = DistributedHostQueryRunner(
+                [bad.base_uri, w1.base_uri, w2.base_uri],
+                session=_task_session()).execute(sql).rows
+        except Exception as e:      # noqa: BLE001
+            errs.append(f"{name}: {e!r}")
+
+    threads = [threading.Thread(target=run, args=("q1", SQL)),
+               threading.Thread(target=run, args=("q2", sql2))]
+    max_open = 0
+    try:
+        for t in threads:
+            t.start()
+        while any(t.is_alive() for t in threads):
+            max_open = max(max_open,
+                           w1.task_executor.open_tasks(),
+                           w2.task_executor.open_tasks())
+            time.sleep(0.005)
+        for t in threads:
+            t.join(60)
+    finally:
+        w1.stop()
+        w2.stop()
+        try:
+            bad.stop()
+        except OSError:
+            pass
+    assert not errs, errs
+    assert results["q1"] == exp["q1"]
+    assert results["q2"] == exp["q2"]
+    # the victim's tasks were rescheduled, not lost
+    assert _counter("trino_tpu_task_retries_total") > retries_before
+    # and the scheduler really had > 1 task registered at once on a
+    # single-slot worker (the interleaving this chaos targets)
+    assert max_open >= 2, max_open
+
+
 def test_single_host_query_spools_bytes_once(tmp_path, expected):
     """End to end on one host: workers commit task output to their
     spool, the coordinator's commit coalesces into hard links — the

@@ -549,33 +549,35 @@ def test_objectstore_retry_budget_exhausted_raises():
     assert spool.commit("q", 0, 0, 1, [b"x"]) == 1
 
 
-def test_worker_killed_with_objectstore_spool_backend(workers,
-                                                     expected):
-    """The PR 5 acceptance kill, re-run with the object-store-shaped
-    spool active: retries spool their output through the bucket
-    emulation (request counter moves) and the query still completes."""
+def test_worker_killed_with_objectstore_spool_backend(expected):
+    """The PR 5 acceptance kill with the object-store-shaped spool
+    active, UN-PINNED onto the default stage path (PR 14): the
+    workers themselves spool stage output through the bucket
+    emulation (each worker's own in-memory store — consumers fall to
+    the HTTP partition leg, the cross-host shape), one worker dies
+    mid-query, and the query completes exactly with the bucket
+    request counter moving."""
     def ops_total():
         return sum(v for _, v in METRICS.counter(
             "trino_tpu_objectstore_requests_total").samples())
 
-    store, spool = _mem_spool()
     killed = _FaultyWorker("kill")
+    w1 = TaskWorkerServer(spool_backend="memory").start()
+    w2 = TaskWorkerServer(spool_backend="memory").start()
     ops_before = ops_total()
+    retries_before = _counter("trino_tpu_task_retries_total")
     try:
-        # flat-path pin: the coordinator-side spool (the injected
-        # object-store emulation here) only receives fragment output
-        # on the leaf-fragment path — stage tasks commit to WORKER
-        # spools and the coordinator reads the final gather off them
         runner = DistributedHostQueryRunner(
-            [killed.base_uri] + workers,
-            session=_task_session(multistage_execution=False),
-            spool=spool)
+            [killed.base_uri, w1.base_uri, w2.base_uri],
+            session=_task_session())
         res = runner.execute(SQL)
     finally:
         killed.stop()
+        w1.stop()
+        w2.stop()
     assert res.rows == expected.rows
     assert ops_total() > ops_before
-    assert store.op_counts.get("put", 0) > 0
+    assert _counter("trino_tpu_task_retries_total") > retries_before
 
 
 def test_fte_metrics_exposed(workers, expected):

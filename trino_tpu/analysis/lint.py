@@ -257,7 +257,11 @@ class _ModuleIndex(ast.NodeVisitor):
 _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   "server/failure.py", "server/resourcegroups.py",
                   "server/memory.py", "exec/hotshapes.py",
-                  "exec/streamjoin.py", "exec/distributed.py")
+                  "exec/streamjoin.py", "exec/distributed.py",
+                  # PR 14: the shared split scheduler — runner/task/
+                  # status threads all mutate its queues, so the race
+                  # detector must see every state write
+                  "exec/taskexec.py")
 
 
 class _CrossIndex:

@@ -386,6 +386,13 @@ class Executor:
             raise QueryError(
                 "Query exceeded the maximum run time "
                 "(query_max_run_time)", error_name="EXCEEDED_TIME_LIMIT")
+        yld = getattr(self.session, "split_yield", None)
+        if yld is not None:
+            # shared split scheduler (exec/taskexec.py): a plan-node
+            # boundary is a yield point too — operators without split
+            # or chunk loops (exchange-fed joins, sorts) still hand
+            # the runner slot to a higher-priority query's task here
+            yld()
         if not self.collect_stats:
             return self._execute_inner(node)
         return self._stats_wrap(node, lambda: self._execute_inner(node))
@@ -474,6 +481,12 @@ class Executor:
                 getattr(self.session, "query_id", "") or "",
                 f"{h.catalog}.{h.schema}.{h.table}"
                 f"[{split.part}/{split.part_count}]", wall))
+        yld = getattr(self.session, "split_yield", None)
+        if yld is not None:
+            # a completed split IS the scheduler quantum (exec/
+            # taskexec.py): account it and maybe hand the runner slot
+            # to a higher-priority query's task before the next split
+            yld()
         return b
 
     def _execute_inner(self, node: PlanNode) -> Batch:
@@ -1837,6 +1850,69 @@ def read_split_cached(conn, split, columns) -> Batch:
                      raw.num_rows)
     rest = conn.read_split(split, columns)
     return rest.on_device() if on_dev else rest
+
+
+def cache_memory_bytes() -> int:
+    """Bytes held by the shared HBM scan caches across every connector
+    — the figure cross-query memory governance (server/memory.py +
+    server/task_worker.py) folds into its pressure arithmetic: cached
+    table lanes share the same device/host memory as query working
+    sets, so a pool sized to the hardware must see them."""
+    with _SCAN_CACHE_LOCK:
+        return sum(int(state["bytes"])
+                   for state in _SCAN_CACHES.values())
+
+
+from ..obs.metrics import CACHE_PRESSURE_EVICTS as _M_CACHE_PRESSURE
+
+
+def evict_cache_pressure(need_bytes: int) -> int:
+    """Shed shared-cache memory under pressure, oldest entries first:
+    the scan caches (byte-accounted) go first; if they cannot cover
+    the deficit the structural jit-program caches drop their oldest
+    half (entry sizes are opaque — compiled closures — so the jit
+    relief is entry-counted, backed by the persistent XLA cache for
+    recompiles) and the replicate fetch-once cache is cleared. Returns
+    the scan-cache bytes actually freed. This is what makes the caches
+    GOVERNED resources: a cache full of one query's programs/tables is
+    evicted before the low-memory killer considers killing a neighbor
+    query (ISSUE 14 tentpole part 3)."""
+    need = max(int(need_bytes), 0)
+    freed = 0
+    with _SCAN_CACHE_LOCK:
+        for conn, state in list(_SCAN_CACHES.items()):
+            while state["order"] and freed < need:
+                old_key = state["order"].pop(0)
+                old = state["entries"].pop(old_key, None)
+                if old is None:
+                    continue
+                sz = sum(_col_bytes(c) for c in old["cols"].values())
+                state["bytes"] -= sz
+                freed += sz
+                _M_CACHE_PRESSURE.inc(cache="scan")
+            _M_SCAN_BYTES.set(state["bytes"],
+                              connector=getattr(conn, "name",
+                                                type(conn).__name__))
+            if freed >= need:
+                break
+    if freed < need:
+        # byte-accounted caches first: the replicate fetch-once cache
+        # frees measurable bytes before the opaque jit closures go
+        try:
+            from ..stage.exchange import evict_replicate_cache
+            freed += evict_replicate_cache(need - freed)
+        except Exception:       # noqa: BLE001 — relief is best-effort
+            pass
+    if freed < need:
+        with _JIT_CACHE_LOCK:
+            for cache in (_CHAIN_JIT_CACHE, _STREAM_JIT_CACHE):
+                for _ in range(len(cache) // 2):
+                    try:
+                        cache.pop(next(iter(cache)))
+                    except (KeyError, StopIteration):
+                        break
+                    _M_CACHE_PRESSURE.inc(cache="jit")
+    return freed
 
 
 def _whole_table_mode() -> bool:

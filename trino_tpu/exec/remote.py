@@ -205,6 +205,44 @@ class RemoteScheduler:
             f"(query_max_run_time) during {where}",
             error_name="EXCEEDED_TIME_LIMIT")
 
+    # -- live memory feedback ------------------------------------------
+    def _live_memory_hook(self, task_id: str):
+        """Per-task beat callback folding a worker's LIVE reservation
+        into the cluster pool (server/memory.py reserve_remote) while
+        the task runs — the low-memory killer then acts on live worker
+        bytes, not completion-time peaks. None when no pool context
+        governs this query or live_memory_feedback is off."""
+        mem = getattr(self.session, "memory", None)
+        feed = getattr(mem, "reserve_remote", None)
+        if feed is None:
+            return None
+        try:
+            if not bool(self.session.get("live_memory_feedback")):
+                return None
+        except KeyError:        # foreign session without the knob
+            pass
+
+        def beat(nbytes) -> None:
+            n = int(nbytes or 0)
+            if n > 0:
+                feed(task_id, n)
+
+        rel = getattr(mem, "release_remote", None)
+
+        def release() -> None:
+            # the attempt is terminal: its worker memory is free, so
+            # the pool stops charging this query for it — without
+            # this, retried attempts and sequential stage tasks
+            # ACCUMULATE dead high-water marks until the killer fires
+            # on a query that never held that much at once
+            if rel is not None:
+                try:
+                    rel(task_id)
+                except Exception:   # noqa: BLE001 — best-effort
+                    pass
+        beat.release = release
+        return beat
+
     def _sync_workers(self) -> None:
         """Append clients for workers that joined since dispatch.
         Append-only: positions of known workers never move (attempt
@@ -599,6 +637,7 @@ class RemoteScheduler:
                 with st.lock:
                     st.running_since = t0
                     st.running_worker = wi
+            beat = self._live_memory_hook(tid)
             try:
                 client.submit_fragment(
                     tid, payloads[f.fid],
@@ -611,7 +650,14 @@ class RemoteScheduler:
                     # the remaining budget: its own executor stops
                     # between plan nodes instead of computing a result
                     # nobody will wait for
-                    deadline_s=self._remaining_s())
+                    deadline_s=self._remaining_s(),
+                    # the admitting group rides into the worker's
+                    # shared split scheduler (fair-share by group)
+                    resource_group=getattr(session, "resource_group",
+                                           None),
+                    group_weight=getattr(session,
+                                         "resource_group_weight",
+                                         None))
                 # the watch event aborts this attempt's page pull the
                 # moment a sibling attempt wins (or the user cancels)
                 watch = _MultiEvent(getattr(session, "cancel", None),
@@ -621,7 +667,10 @@ class RemoteScheduler:
                     tid, cancel=watch,
                     timeout_s=self._attempt_budget_s(
                         float(session.get("remote_task_timeout"))),
-                    meta_out=meta)
+                    meta_out=meta,
+                    # 202 polls carry the running task's live
+                    # reservation into the cluster pool
+                    on_beat=beat)
             except Exception as e:     # noqa: BLE001
                 st.last_window = (t0, _time.perf_counter())
                 if not speculative:
@@ -642,6 +691,14 @@ class RemoteScheduler:
                     # a user cancel is not the worker's failure: no
                     # detector demerit, no exclusion
                     return (f"fragment {f.fid} task {tid}: canceled")
+                if _busy_decline(e):
+                    # retryable BUSY shed (worker 503): the worker is
+                    # healthy, just loaded — rotate to another worker
+                    # WITHOUT a detector demerit or per-query
+                    # exclusion (it stays eligible for later attempts)
+                    return (f"{BUSY_MARK} fragment {f.fid} task {tid} "
+                            f"on worker {client.base_uri}: busy "
+                            "(load shed)")
                 if self.failure_detector is not None:
                     self.failure_detector.record_task_failure(
                         client.base_uri, f"{type(e).__name__}: {e}")
@@ -649,6 +706,9 @@ class RemoteScheduler:
                     self.excluded.add(wi)
                 return (f"fragment {f.fid} task {tid} on worker "
                         f"{client.base_uri}: {type(e).__name__}: {e}")
+            finally:
+                if beat is not None:
+                    beat.release()  # terminal attempt: stop charging
             t1 = _time.perf_counter()
             st.last_window = (t0, t1)
             if self.failure_detector is not None:
@@ -773,6 +833,7 @@ class RemoteScheduler:
             the retry budgets, pick a replacement worker, back off,
             go again."""
             failures = 0
+            busy_declines = 0
             attempt = st.next_attempt()
             while True:
                 if attempt > 0:
@@ -804,6 +865,23 @@ class RemoteScheduler:
                     # past it would only burn worker time the client
                     # has already given up on
                     canceled = True
+                if err.startswith(BUSY_MARK) and not canceled:
+                    # a BUSY decline is not a task failure — the
+                    # dispatch never started. Back off and rotate
+                    # WITHOUT consuming the retry budget (bounded so
+                    # a permanently wedged fleet still fails): this is
+                    # how the existing machinery "absorbs" load shed
+                    busy_declines += 1
+                    if busy_declines <= BUSY_RETRY_LIMIT:
+                        delay = backoff_delay(
+                            policy, failures,
+                            f"{qid}.{st.fragment.fid}.{st.part}")
+                        if rem is not None:
+                            delay = min(delay, max(rem, 0.0))
+                        if st.done.wait(delay):
+                            return
+                        attempt = st.next_attempt()
+                        continue
                 if canceled or not controller.record_failure(
                         (st.fragment.fid, st.part)):
                     # out of attempts — but first-completion-wins cuts
@@ -988,6 +1066,23 @@ class RemoteScheduler:
             if spool is not None:
                 spool.release(qid)
         return out
+
+
+# error-string marker for a worker's retryable BUSY shed, and the
+# bound on budget-free re-dispatches per task (a permanently wedged
+# fleet must still fail the query through the normal budget machinery
+# instead of spinning forever)
+BUSY_MARK = "[busy]"
+BUSY_RETRY_LIMIT = 64
+
+
+def _busy_decline(e: BaseException) -> bool:
+    """True for a worker's retryable BUSY shed (HTTP 503 from
+    server/task_worker.py WorkerBusyError): the dispatch was DECLINED,
+    not failed — the retry machinery rotates to another worker and the
+    shedding worker keeps its health record clean."""
+    import urllib.error
+    return isinstance(e, urllib.error.HTTPError) and e.code == 503
 
 
 class _TaskRun:

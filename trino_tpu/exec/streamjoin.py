@@ -351,6 +351,13 @@ def run_streamed(ex, op: str, host_iter: Iterable[Batch],
                 "Query exceeded the maximum run time "
                 "(query_max_run_time)",
                 error_name="EXCEEDED_TIME_LIMIT")
+        yld = getattr(ex.session, "split_yield", None)
+        if yld is not None:
+            # shared split scheduler (exec/taskexec.py): a streamed
+            # chunk is the quantum — a thousand-chunk stream yields
+            # its runner slot to higher-priority queries per chunk
+            # instead of owning the worker to completion
+            yld()
         # per-chunk spans are capped: a million-chunk stream must not
         # hold (and ship, via worker task status) a Span per chunk —
         # the tail is summarized in one stream_tail span below

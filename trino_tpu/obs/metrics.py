@@ -382,6 +382,44 @@ STREAM_OVERLAPPED = METRICS.counter(
     "Chunk transfers issued while the previous chunk's compute was "
     "still in flight (the double-buffer overlap)")
 
+# worker-side multi-query runtime (exec/taskexec.py +
+# server/task_worker.py): the shared split scheduler interleaving
+# splits/chunks from every concurrent query's tasks, live per-task
+# memory beats into the cluster pool, pressure-driven cache eviction,
+# and the BUSY load-shed signal. Registered here — not in the lazily
+# imported scheduler module — so scrapes and bench deltas see one
+# family identity regardless of import order.
+TASK_SCHED_QUANTA = METRICS.counter(
+    "trino_tpu_task_scheduler_quanta_total",
+    "Split/chunk quanta the shared task scheduler accounted, by "
+    "resource group (the fairness observable)", ("group",))
+TASK_SCHED_YIELDS = METRICS.counter(
+    "trino_tpu_task_scheduler_yields_total",
+    "Times a task handed its runner slot to a higher-priority task "
+    "at a split/chunk boundary")
+TASK_SCHED_RUNNABLE = METRICS.gauge(
+    "trino_tpu_task_scheduler_open_tasks",
+    "Tasks currently registered with the shared task scheduler "
+    "(running + waiting + blocked)")
+WORKER_BUSY_REJECTS = METRICS.counter(
+    "trino_tpu_worker_busy_rejections_total",
+    "Task dispatches this worker declined with the retryable BUSY "
+    "signal under sustained load (the stage scheduler's retry/"
+    "rotation machinery re-places them)")
+LIVE_MEMORY_BEATS = METRICS.counter(
+    "trino_tpu_worker_live_memory_beats_total",
+    "Worker-reported live task reservations folded into the cluster "
+    "memory pool DURING execution (status-poll beats)")
+CACHE_PRESSURE_EVICTS = METRICS.counter(
+    "trino_tpu_cache_pressure_evictions_total",
+    "Cache entries evicted by memory-pressure governance, by cache "
+    "(scan = HBM scan cache, jit = structural program caches, "
+    "replicate = exchange fetch-once cache)", ("cache",))
+REPLICATE_CACHE = METRICS.counter(
+    "trino_tpu_exchange_replicate_cache_total",
+    "Per-worker fetch-once cache lookups on replicate exchange "
+    "edges, by outcome", ("result",))
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

@@ -272,6 +272,15 @@ class QueryTracker:
 
         def run_and_release():
             q.started = time.time()  # tt-lint: ignore[race-attr-write] single stamp before the query publishes; readers tolerate None
+            if q.group is not None:
+                # the admitting group's identity + scheduling weight
+                # ride the session so remote/stage task payloads carry
+                # them into the WORKER's shared split scheduler
+                # (exec/taskexec.py fair-share drain by group)
+                session.resource_group = getattr(
+                    q.group, "full_name", "global")
+                session.resource_group_weight = float(
+                    getattr(q.group, "scheduling_weight", 1) or 1)
             if self.memory is not None:
                 # cluster memory governance: the pool ledger tracks
                 # this query from first reservation to completion; the

@@ -99,6 +99,13 @@ def main(argv=None) -> int:
                          "env TRINO_TPU_PREWARM_TOP_K; pre-warm "
                          "disabled entirely via TRINO_TPU_PREWARM=0 or "
                          "prewarm.enabled=false)")
+    ap.add_argument("--task-runners", type=int, default=None,
+                    help="[worker role] size of the shared split-"
+                         "scheduler runner pool time-slicing all "
+                         "concurrent queries' tasks (exec/taskexec.py; "
+                         "0 = auto, max(4, 2 x cores)). Also "
+                         "task.runner-threads in config.properties / "
+                         "env TRINO_TPU_TASK_RUNNERS")
     ap.add_argument("--spool-backend", default=None,
                     help="fault-tolerance spool backend: 'local' "
                          "(directory tree) or 'memory' (object-store "
@@ -198,8 +205,12 @@ def _worker_main(args, props: Dict[str, str], port: int) -> int:
     spool_backend = (args.spool_backend
                      or props.get("spool.backend") or None)
     plugins = [m for m in props.get("plugin.load", "").split(",") if m]
+    task_runners = args.task_runners
+    if task_runners is None and props.get("task.runner-threads"):
+        task_runners = int(props["task.runner-threads"])
     srv = TaskWorkerServer(
         port=port, spool_backend=spool_backend,
+        task_runners=task_runners,
         # the worker resolves the same etc/catalog configs the
         # coordinator dispatches fragments against — without this a
         # fragment naming an operator-configured catalog fails on

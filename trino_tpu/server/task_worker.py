@@ -49,6 +49,17 @@ _M_TASKS_ABORTED = METRICS.counter(
     "this worker: user cancels, deadline breaches, attempt timeouts, "
     "and attempts superseded by a winning sibling")
 
+from ..obs.metrics import WORKER_BUSY_REJECTS as _M_BUSY  # noqa: E402
+
+
+class WorkerBusyError(Exception):
+    """Raised by ``create_task`` when the worker sheds load under
+    sustained pressure (open tasks past the shed threshold, or the
+    worker memory budget breached). Surfaces as HTTP 503 — a
+    RETRYABLE decline the dispatching scheduler's existing retry/
+    rotation machinery absorbs by re-placing the task on another
+    worker (no failure-detector demerit: a busy worker is healthy)."""
+
 
 def _slice_batch(b: Batch, lo: int, hi: int) -> Batch:
     cols = {}
@@ -85,13 +96,52 @@ def paginate(b: Batch, page_rows: int = PAGE_ROWS,
     return frames
 
 
+class _TaskMemoryContext:
+    """Worker-side ``session.memory``: records the task's live
+    high-water reservation (the figure ``liveMemoryBytes`` status
+    beats stream back to the coordinator's cluster pool DURING
+    execution) and triggers worker-local cache-pressure relief. It
+    never enforces — the coordinator pool owns kill verdicts, and a
+    kill reaches this task as a DELETE."""
+
+    __slots__ = ("_task", "_worker")
+
+    def __init__(self, task: "_Task", worker):
+        self._task = task
+        self._worker = worker
+
+    def reserve(self, nbytes: int) -> None:
+        t = self._task
+        if int(nbytes) > t.live_memory_bytes:
+            t.live_memory_bytes = int(nbytes)  # tt-lint: ignore[race-attr-write] single-writer (the task's executor thread); status threads read a monotonic int
+            if self._worker is not None:
+                self._worker.relieve_memory_pressure()
+
+    def budget_bytes(self):
+        """The worker-local byte budget (streaming engagement consults
+        this exactly like the coordinator pool's budget); None when
+        worker-local governance is off."""
+        from ..config import CONFIG
+        b = int(CONFIG.worker_memory_bytes or 0)
+        return b if b > 0 else None
+
+
 class _Task:
     """One task's lifecycle + output buffer (execution/SqlTask.java +
     the ClientBuffer token protocol)."""
 
     def __init__(self, task_id: str, attempt: int = 0, spool=None,
-                 catalogs=None):
+                 catalogs=None, worker=None):
         self.task_id = task_id
+        # the owning TaskWorkerServer: carries the shared split
+        # scheduler (exec/taskexec.py) this task's execution is
+        # time-sliced through; None for schedulerless embedding
+        self.worker = worker
+        # live high-water reservation (bytes) of this task's executor,
+        # updated DURING execution by _TaskMemoryContext and served in
+        # every status response — the worker->coordinator live memory
+        # feed (ISSUE 14 tentpole part 2)
+        self.live_memory_bytes = 0
         # the worker's shared CatalogManager (etc/catalog configs —
         # None falls back to the runner's built-in defaults): a
         # fragment naming an operator-configured catalog must resolve
@@ -135,6 +185,7 @@ class _Task:
     def run(self, payload: dict):
         from ..exec.hotshapes import HOT_SHAPES
         shapes_before = HOT_SHAPES.hit_counts()
+        handle = None
         try:
             from ..runner import LocalQueryRunner
             from ..session import Session
@@ -143,6 +194,24 @@ class _Task:
                               cancel=self.cancel_ev)
             for name, value in payload.get("properties", {}).items():
                 session.set(name, value)
+            if self.worker is not None:
+                # shared split scheduler (exec/taskexec.py): every
+                # task registers with its query identity (the task-id
+                # prefix groups all of one dispatch's tasks) and its
+                # resource group's fair-share weight; execution only
+                # proceeds while holding one of the worker's bounded
+                # runner slots, yielded at split/chunk boundaries
+                handle = self.worker.task_executor.register(
+                    self.task_id.split(".", 1)[0], self.task_id,
+                    group=str(payload.get("resource_group")
+                              or "global"),
+                    weight=float(payload.get("group_weight") or 1.0),
+                    cancel=self.cancel_ev)
+                session.split_yield = handle.checkpoint
+            # live memory accounting: the executor's reservations land
+            # on this task (status beats carry them to the
+            # coordinator's pool) and arm worker-local cache relief
+            session.memory = _TaskMemoryContext(self, self.worker)
             # deadline propagation (server/coordinator.py -> exec/
             # remote.py): the coordinator ships the REMAINING budget
             # (relative seconds — wall clocks differ across hosts) and
@@ -200,9 +269,20 @@ class _Task:
                         timeout_s=float(
                             session.get("remote_task_timeout")),
                         cancel=self.cancel_ev)
-                    ex.exchange_reader = puller.read_fragment
+                    if handle is not None:
+                        # a pipelined consumer blocked on an upstream
+                        # commit must not hold a runner slot: bounded
+                        # runners would otherwise deadlock a producer
+                        # behind its own consumer
+                        ex.exchange_reader = (
+                            lambda fid: handle.run_blocked(
+                                puller.read_fragment, fid))
+                    else:
+                        ex.exchange_reader = puller.read_fragment
                     if isinstance(plan, PartitionedOutputNode):
                         body = plan.source
+                if handle is not None:
+                    handle.acquire()   # wait for a fair-share slot
                 if trace is not None:
                     with trace.span("task_execute",
                                     task=self.task_id):
@@ -218,6 +298,8 @@ class _Task:
             else:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
+                if handle is not None:
+                    handle.acquire()   # wait for a fair-share slot
                 res = runner.execute_batch(payload["sql"])
             codec = None
             if not bool(session.get("exchange_compression")):
@@ -262,6 +344,9 @@ class _Task:
             self.state = "FAILED"  # tt-lint: ignore[race-attr-write] races only with abort's CANCELED stamp; either terminal state is valid, done.set() publishes
             self.error = f"{type(e).__name__}: {e}"  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
         finally:
+            if handle is not None:
+                handle.close()      # release the runner slot + the
+                #                     scheduler's per-query accounting
             try:
                 # hit-count DELTAS since this task started: concurrent
                 # tasks may each claim a shared sighting (their deltas
@@ -280,9 +365,27 @@ class TaskWorkerServer:
     One process per worker (the reference's worker JVM)."""
 
     def __init__(self, port: int = 0, spool_dir: Optional[str] = None,
-                 spool_backend: Optional[str] = None, catalogs=None):
+                 spool_backend: Optional[str] = None, catalogs=None,
+                 task_runners: Optional[int] = None,
+                 busy_shed_factor: Optional[int] = None):
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
+        # shared split scheduler (exec/taskexec.py): ONE bounded
+        # runner pool time-slices every concurrent query's task
+        # splits/chunks with multilevel fair-share priority —
+        # ``task_runners`` (default CONFIG.task_runner_threads; 0 =
+        # max(4, 2 x cores)) bounds how many tasks EXECUTE at once
+        import os as _os
+        from ..config import CONFIG
+        from ..exec.taskexec import TaskExecutor
+        n = (int(task_runners) if task_runners is not None
+             else int(CONFIG.task_runner_threads))
+        if n <= 0:
+            n = max(4, 2 * (_os.cpu_count() or 1))
+        self.task_executor = TaskExecutor(n)
+        self.busy_shed_factor = (
+            int(busy_shed_factor) if busy_shed_factor is not None
+            else int(CONFIG.busy_shed_factor))
         # operator-configured catalogs (etc/catalog via
         # main.build_catalogs) — None means the runner's defaults; a
         # standalone worker must resolve the same catalog names the
@@ -312,7 +415,23 @@ class TaskWorkerServer:
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
-                    t = worker.create_task(parts[2], payload)
+                    try:
+                        t = worker.create_task(parts[2], payload)
+                    except WorkerBusyError as e:
+                        # graceful degradation: a 503 is the RETRYABLE
+                        # busy signal — the scheduler re-places the
+                        # task on another worker without demeriting
+                        # this one in the failure detector
+                        body = json.dumps(
+                            {"error": str(e), "busy": True}).encode()
+                        self.send_response(503)
+                        self.send_header("Content-Type",
+                                         "application/json")
+                        self.send_header("Content-Length",
+                                         str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
                     body = json.dumps(
                         {"taskId": t.task_id, "state": t.state}).encode()
                     self.send_response(200)
@@ -338,6 +457,14 @@ class TaskWorkerServer:
                     if not t.done.wait(timeout=2.0) \
                             and t.state == "RUNNING":
                         self.send_response(202)
+                        # live memory beat for the flat dispatch path:
+                        # the puller's 202 polls carry the task's live
+                        # reservation so the coordinator pool sees
+                        # worker bytes DURING execution (the stage
+                        # path reads the same figure off the status
+                        # JSON its wait_done polls)
+                        self.send_header("X-TT-Live-Memory",
+                                         str(t.live_memory_bytes))
                         self.send_header("Content-Length", "0")
                         self.end_headers()
                         return
@@ -452,6 +579,7 @@ class TaskWorkerServer:
                          "spans": t.spans,
                          "hotShapes": t.hot_shapes,
                          "peakMemoryBytes": t.peak_memory_bytes,
+                         "liveMemoryBytes": t.live_memory_bytes,
                          "spillBytes": t.spill_bytes,
                          "streamChunks": t.stream_chunks,
                          "streamH2dBytes": t.stream_h2d_bytes}).encode()
@@ -464,6 +592,27 @@ class TaskWorkerServer:
                 if self.path.split("?")[0] == "/metrics":
                     from ..obs.metrics import write_exposition
                     write_exposition(self)
+                    return
+                # liveness surface: the coordinator's heartbeat
+                # failure detector probes /v1/info (server/failure.py
+                # _http_probe expects a JSON 200). Without it a REAL
+                # worker process is declared dead after the warmup
+                # probes and the coordinator silently stops
+                # dispatching to it — found driving the multi-process
+                # cluster, invisible to in-process tests whose
+                # feedback-only detectors never probe.
+                if self.path.split("?")[0] == "/v1/info":
+                    body = json.dumps(
+                        {"nodeId": worker.node_id,
+                         "uri": worker.base_uri,
+                         "coordinator": False,
+                         "state": "active"}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                     return
                 self.send_error(404)
 
@@ -509,11 +658,70 @@ class TaskWorkerServer:
         self.node_id = f"worker-{_uuid.uuid4().hex[:8]}"
 
     # -- task manager (SqlTaskManager) --------------------------------
+    def live_task_bytes(self) -> int:
+        """Sum of RUNNING tasks' live high-water reservations — the
+        worker-local half of the memory-governance arithmetic.
+        Finished tasks stay in the registry to serve status/pages,
+        but their memory is free: counting them would eventually trip
+        the shed/relief thresholds on a long-lived worker."""
+        with self._lock:
+            return sum(t.live_memory_bytes
+                       for t in self._tasks.values()
+                       if t.state == "RUNNING")
+
+    def relieve_memory_pressure(self) -> None:
+        """Worker-local cache governance: when live task reservations
+        plus shared-cache residency exceed the worker memory budget
+        (CONFIG.worker_memory_bytes), shed cache entries — caches
+        yield to queries, never the other way around. No-op when the
+        budget is 0 (the coordinator pool still governs globally)."""
+        from ..config import CONFIG
+        budget = int(CONFIG.worker_memory_bytes or 0)
+        if budget <= 0:
+            return
+        from ..exec.executor import (cache_memory_bytes,
+                                     evict_cache_pressure)
+        usage = self.live_task_bytes() + cache_memory_bytes()
+        if usage > budget:
+            evict_cache_pressure(usage - budget)
+
+    def _shed_reason(self) -> Optional[str]:
+        """Non-None when this worker should decline NEW dispatches
+        with the retryable BUSY signal (graceful degradation): open
+        tasks past busy_shed_factor x runner slots, or the worker
+        memory budget breached by live reservations alone."""
+        factor = int(self.busy_shed_factor or 0)
+        if factor > 0:
+            open_tasks = self.task_executor.open_tasks()
+            cap = factor * self.task_executor.runners
+            if open_tasks >= cap:
+                return (f"{open_tasks} open tasks >= shed threshold "
+                        f"{cap} ({self.task_executor.runners} runners"
+                        f" x factor {factor})")
+        from ..config import CONFIG
+        budget = int(CONFIG.worker_memory_bytes or 0)
+        if budget > 0:
+            live = self.live_task_bytes()
+            if live > budget:
+                return (f"live task reservations {live} bytes over "
+                        f"the worker memory budget {budget}")
+        return None
+
     def create_task(self, tid: str, payload: dict) -> _Task:
         try:      # reap expired spooled output (time-gated internally)
             self.spool.maybe_cleanup()
         except Exception:        # noqa: BLE001
             pass
+        with self._lock:
+            t = self._tasks.get(tid)
+        if t is not None:
+            return t          # idempotent update (TaskResource) —
+            #                   never shed a re-POST of a known task
+        reason = self._shed_reason()
+        if reason is not None:
+            _M_BUSY.inc()
+            raise WorkerBusyError(
+                f"worker {self.base_uri} is shedding load: {reason}")
         with self._lock:
             t = self._tasks.get(tid)
             if t is not None:
@@ -523,7 +731,7 @@ class TaskWorkerServer:
                       # exchange medium its consumers read
                       spool=(self.spool if payload.get("spool")
                              or payload.get("stage") else None),
-                      catalogs=self.catalogs)
+                      catalogs=self.catalogs, worker=self)
             self._tasks[tid] = t
         threading.Thread(target=t.run, args=(payload,),
                          daemon=True).start()
@@ -780,7 +988,9 @@ class RemoteTaskClient:
                         collect_stats: bool = False,
                         attempt: int = 0, spool: bool = False,
                         stage: Optional[dict] = None,
-                        deadline_s: Optional[float] = None):
+                        deadline_s: Optional[float] = None,
+                        resource_group: Optional[str] = None,
+                        group_weight: Optional[float] = None):
         """POST a serialized plan fragment + split share (the
         HttpRemoteTask TaskUpdateRequest analog). ``attempt`` tags the
         task's retry/speculation generation; ``spool`` asks the worker
@@ -790,7 +1000,10 @@ class RemoteTaskClient:
         partition count, and the upstream exchange sources to pull.
         ``deadline_s`` is the query's REMAINING wall-clock budget in
         seconds (relative — host clocks differ); the worker re-derives
-        an absolute deadline for its executor."""
+        an absolute deadline for its executor. ``resource_group`` /
+        ``group_weight`` carry the admitting group's identity and
+        scheduling weight into the worker's shared split scheduler
+        (exec/taskexec.py fair-share drain)."""
         body = {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
@@ -801,6 +1014,10 @@ class RemoteTaskClient:
             body["stage"] = stage
         if deadline_s is not None:
             body["deadline_s"] = float(deadline_s)
+        if resource_group is not None:
+            body["resource_group"] = str(resource_group)
+        if group_weight is not None:
+            body["group_weight"] = float(group_weight)
         return self._post(task_id, body)
 
     def status(self, task_id: str) -> dict:
@@ -812,13 +1029,16 @@ class RemoteTaskClient:
 
     def wait_done(self, task_id: str, cancel=None,
                   timeout_s: float = 600.0,
-                  poll_s: float = 0.05) -> dict:
+                  poll_s: float = 0.05, on_status=None) -> dict:
         """Poll task status until a terminal state and return the final
         status JSON (a stage task's consumers read its output off the
         spool/partition endpoint, so completion — not pages — is what
         the scheduler waits on). ``cancel`` (anything with ``is_set``)
         aborts between polls; ``timeout_s`` bounds the wait on a
-        wedged worker, turning it into a retriable attempt failure."""
+        wedged worker, turning it into a retriable attempt failure.
+        ``on_status`` receives every polled status dict WHILE the task
+        runs — the live-memory beat hook (the stage scheduler feeds
+        ``liveMemoryBytes`` into the cluster pool per poll)."""
         import time as _time
         deadline = _time.monotonic() + timeout_s
         while True:
@@ -836,6 +1056,12 @@ class RemoteTaskClient:
                 raise RuntimeError(
                     f"task {task_id} did not finish in {timeout_s}s")
             st = self.status(task_id)
+            if on_status is not None:
+                try:
+                    on_status(st)
+                except Exception:       # noqa: BLE001 — a beat
+                    pass                # consumer bug must not fail
+                #                        the attempt
             if st.get("state") != "RUNNING":
                 return st
             _time.sleep(poll_s)
@@ -850,7 +1076,8 @@ class RemoteTaskClient:
 
     def pages_raw(self, task_id: str, cancel=None,
                   timeout_s: float = 600.0,
-                  meta_out: Optional[dict] = None) -> List[bytes]:
+                  meta_out: Optional[dict] = None,
+                  on_beat=None) -> List[bytes]:
         """Pull every result page FRAME (token-acknowledged bounded
         poll) — raw serialized bytes, so callers can spool them without
         a decode/re-encode round trip. ``cancel`` (anything with
@@ -893,6 +1120,16 @@ class RemoteTaskClient:
                 with urllib.request.urlopen(
                         f"{self.base_uri}{path}", timeout=per_req) as r:
                     if r.status == 202:     # still running: poll again
+                        if on_beat is not None:
+                            # live-memory beat on the flat path: the
+                            # 202 carries the running task's current
+                            # reservation (X-TT-Live-Memory)
+                            live = r.headers.get("X-TT-Live-Memory")
+                            if live:
+                                try:
+                                    on_beat(int(live))
+                                except Exception:  # noqa: BLE001
+                                    pass
                         continue
                     complete = r.headers.get("X-TT-Complete") == "true"
                     if meta_out is not None:

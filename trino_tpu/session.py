@@ -147,6 +147,15 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # < 0 disables streaming (fall back to the materialized path and
     # its memory errors — the operator escape hatch)
     "stream_chunk_rows": (int, CONFIG.stream_chunk_rows),
+    # ---- worker-side multi-query runtime (exec/taskexec.py) ----------
+    # stream per-task live memory reservations from workers back into
+    # the coordinator's cluster memory pool DURING execution (status-
+    # poll beats), so the low-memory killer acts on live worker bytes
+    # instead of coordinator-side estimates. Off = workers still
+    # account locally but the pool only sees coordinator reservations
+    # + completion-time peaks (the pre-PR-14 behavior; the escape
+    # hatch for tests pinning killer provenance).
+    "live_memory_feedback": (bool, True),
 }
 
 
@@ -182,6 +191,18 @@ class Session:
     # capacity estimates into the cluster pool, arming the per-group
     # limits and the low-memory killer
     memory: Optional[object] = None
+    # the admitting resource group's identity + scheduling weight
+    # (stamped by the coordinator tracker): the remote/stage
+    # schedulers ship these in task payloads so the WORKER's shared
+    # split scheduler (exec/taskexec.py) drains fair-share by group
+    resource_group: str = "global"
+    resource_group_weight: float = 1.0
+    # worker-side split scheduler yield hook (exec/taskexec.py
+    # TaskHandle.checkpoint, installed by server/task_worker.py on
+    # task sessions): the executor calls it at split/chunk boundaries
+    # so concurrent queries' tasks interleave on the shared runner
+    # pool; None outside a scheduled worker task
+    split_yield: Optional[object] = None
 
     def remaining_time(self) -> Optional[float]:
         """Seconds left before the deadline (None = no deadline).

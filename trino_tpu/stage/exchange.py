@@ -46,11 +46,14 @@ ATTEMPT fails and the stage scheduler's retry machinery takes over.
 
 from __future__ import annotations
 
+import threading
 import time
 import urllib.request
-from typing import Dict, List, Optional
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
-from ..obs.metrics import EXCHANGE_PARTITION_BYTES, EXCHANGE_PARTITIONS
+from ..obs.metrics import (EXCHANGE_PARTITION_BYTES,
+                           EXCHANGE_PARTITIONS, REPLICATE_CACHE)
 
 
 def exchange_task_key(query_id: str, sid: int, part: int) -> str:
@@ -58,6 +61,70 @@ def exchange_task_key(query_id: str, sid: int, part: int) -> str:
     (every attempt of the task commits under this key; the COMMITTED
     marker arbitrates)."""
     return f"{query_id}.s{sid}.p{part}"
+
+
+# --------------------------------------------------------------------------
+# per-worker fetch-once cache for replicate exchange edges: EVERY
+# consumer task of a replicated (broadcast) stage output reads the
+# SAME frame 0 of every upstream task, so without a cache a worker
+# running N consumer tasks pulls (and a remote producer serves) the
+# identical frame N times — on the HTTP path that is N network round
+# trips per edge (ROADMAP item 4 leftover). Keyed by the attempt-
+# independent exchange key + frame index: first-commit-wins makes the
+# bytes under a key immutable once committed, so a cached frame can
+# never go stale. LRU by bytes (CONFIG.replicate_cache_bytes); also
+# shed under memory pressure (exec/executor.py evict_cache_pressure).
+# --------------------------------------------------------------------------
+
+_REPL_LOCK = threading.Lock()
+_REPL_CACHE: "OrderedDict[Tuple[str, int], bytes]" = OrderedDict()
+_REPL_BYTES = [0]           # box: mutated under _REPL_LOCK only
+
+
+def _replicate_cache_get(key: str, index: int) -> Optional[bytes]:
+    with _REPL_LOCK:
+        frame = _REPL_CACHE.get((key, index))
+        if frame is not None:
+            _REPL_CACHE.move_to_end((key, index))
+    REPLICATE_CACHE.inc(result="hit" if frame is not None else "miss")
+    return frame
+
+
+def _replicate_cache_put(key: str, index: int, frame: bytes) -> None:
+    from ..config import CONFIG
+    limit = int(CONFIG.replicate_cache_bytes or 0)
+    if limit <= 0 or len(frame) > limit:
+        return                  # disabled, or the frame alone busts it
+    with _REPL_LOCK:
+        if (key, index) in _REPL_CACHE:
+            return              # a concurrent consumer won the fill
+        while _REPL_BYTES[0] + len(frame) > limit and _REPL_CACHE:
+            _, old = _REPL_CACHE.popitem(last=False)
+            _REPL_BYTES[0] -= len(old)
+        _REPL_CACHE[(key, index)] = frame
+        _REPL_BYTES[0] += len(frame)
+
+
+def evict_replicate_cache(need_bytes: Optional[int] = None) -> int:
+    """Shed fetch-once cache bytes oldest-first (memory-pressure
+    governance hook; ``None`` clears everything). Returns bytes
+    freed."""
+    freed = 0
+    with _REPL_LOCK:
+        while _REPL_CACHE and (need_bytes is None
+                               or freed < int(need_bytes)):
+            _, old = _REPL_CACHE.popitem(last=False)
+            _REPL_BYTES[0] -= len(old)
+            freed += len(old)
+    if freed:
+        from ..obs.metrics import CACHE_PRESSURE_EVICTS
+        CACHE_PRESSURE_EVICTS.inc(cache="replicate")
+    return freed
+
+
+def replicate_cache_bytes() -> int:
+    with _REPL_LOCK:
+        return _REPL_BYTES[0]
 
 
 class ExchangePuller:
@@ -169,8 +236,17 @@ class ExchangePuller:
         index = 0 if kind in ("gather", "replicate") else None
         out, nbytes = [], 0
         for key, uri in zip(tasks, uris):
-            frame = self.pull_frame(key, uri, index=index,
-                                    candidates=candidates, eager=eager)
+            frame = None
+            if kind == "replicate":
+                # fetch-once: sibling consumer tasks on this worker
+                # already pulled the identical broadcast frame
+                frame = _replicate_cache_get(key, 0)
+            if frame is None:
+                frame = self.pull_frame(key, uri, index=index,
+                                        candidates=candidates,
+                                        eager=eager)
+                if kind == "replicate":
+                    _replicate_cache_put(key, 0, frame)
             nbytes += len(frame)
             out.append(deserialize_batch(frame))
         EXCHANGE_PARTITIONS.inc(len(out), direction="read")

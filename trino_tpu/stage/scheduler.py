@@ -329,6 +329,16 @@ class StageExecution:
                 with st.lock:
                     st.running_since = t0
                     st.running_worker = wi
+            # live memory beats: while the task runs, every status
+            # poll folds its current worker-side reservation into the
+            # cluster pool (exec/remote.py _live_memory_hook ->
+            # server/memory.py reserve_remote), so the low-memory
+            # killer judges live worker bytes DURING execution
+            beat = s._live_memory_hook(tid)
+            on_status = None
+            if beat is not None:
+                def on_status(stt, _beat=beat):
+                    _beat(stt.get("liveMemoryBytes") or 0)
             try:
                 client.submit_fragment(
                     tid, self.payloads[sid],
@@ -338,6 +348,11 @@ class StageExecution:
                     collect_stats=s.collect_stats,
                     attempt=attempt, spool=True,
                     deadline_s=s._remaining_s(),
+                    resource_group=getattr(session, "resource_group",
+                                           None),
+                    group_weight=getattr(session,
+                                         "resource_group_weight",
+                                         None),
                     stage={"sid": sid, "exchange_key": st.key,
                            "nparts_out": nout,
                            "sources": self._snapshot_sources(stage)})
@@ -345,7 +360,8 @@ class StageExecution:
                                st.done, self.abort)
                 status = client.wait_done(
                     tid, cancel=watch,
-                    timeout_s=s._attempt_budget_s(timeout_s))
+                    timeout_s=s._attempt_budget_s(timeout_s),
+                    on_status=on_status)
                 if status.get("state") != "FINISHED":
                     raise RuntimeError(
                         f"task is {status.get('state')}: "
@@ -368,6 +384,14 @@ class StageExecution:
                     # demerit, no exclusion
                     return (f"stage {sid} fragment task {tid}: aborted "
                             "(query failed in another stage)")
+                from ..exec.remote import BUSY_MARK, _busy_decline
+                if _busy_decline(e):
+                    # retryable BUSY shed (worker 503): rotate to
+                    # another worker without a detector demerit or
+                    # per-query exclusion — the worker is healthy
+                    return (f"{BUSY_MARK} stage {sid} fragment task "
+                            f"{tid} on worker {client.base_uri}: "
+                            "busy (load shed)")
                 if s.failure_detector is not None:
                     s.failure_detector.record_task_failure(
                         client.base_uri, f"{type(e).__name__}: {e}")
@@ -375,6 +399,9 @@ class StageExecution:
                     s.excluded.add(wi)
                 return (f"stage {sid} fragment task {tid} on worker "
                         f"{client.base_uri}: {type(e).__name__}: {e}")
+            finally:
+                if beat is not None:
+                    beat.release()  # terminal attempt: stop charging
             t1 = time.perf_counter()
             if s.failure_detector is not None:
                 s.failure_detector.record_task_success(client.base_uri)
@@ -434,7 +461,9 @@ class StageExecution:
             return None
 
         def run_task(st: _STask) -> None:
+            from ..exec.remote import BUSY_MARK, BUSY_RETRY_LIMIT
             failures = 0
+            busy_declines = 0
             attempt = st.next_attempt()
             while True:
                 if attempt > 0:
@@ -459,6 +488,22 @@ class StageExecution:
                 rem = s._remaining_s()
                 if rem is not None and rem <= 0:
                     canceled = True     # deadline outranks the budget
+                if err.startswith(BUSY_MARK) and not canceled:
+                    # a BUSY decline never started the dispatch: back
+                    # off and rotate without consuming the retry
+                    # budget (bounded — a permanently wedged fleet
+                    # still fails through the budget machinery)
+                    busy_declines += 1
+                    if busy_declines <= BUSY_RETRY_LIMIT:
+                        delay = backoff_delay(
+                            self.policy, failures,
+                            f"{self.qid}.s{sid}.{st.part}")
+                        if rem is not None:
+                            delay = min(delay, max(rem, 0.0))
+                        if st.done.wait(delay):
+                            return
+                        attempt = st.next_attempt()
+                        continue
                 if canceled or not self.controller.record_failure(
                         (sid, st.part)):
                     # out of attempts — but a healthy speculative
