@@ -284,26 +284,55 @@ def _leg_q18(schema: str) -> dict:
 
 
 def _leg_telemetry(schema: str, iters: int) -> dict:
-    """Fractional overhead of per-node stats collection: TPC-H q1
-    through the full engine with collect_node_stats OFF vs ON (the
-    always-on OperatorStats question — the stats fence adds a device
-    sync per plan node, so this ratio is what decides whether stats
-    can default on). ``overhead`` is a fraction (0.03 = 3% slower);
-    the compile/warm split rides along from the stats-off run."""
+    """Fractional overhead of telemetry on the DEFAULT (multistage
+    MPP) distributed path: TPC-H q1 through two in-process workers
+    with collect_node_stats OFF vs ON — ON meaning the full PR 15
+    stack: distributed tracing (traceparent propagation, id-preserving
+    span merge), device/CPU attribution, AND OTLP file export. The
+    always-on OperatorStats question — this ratio is what decides
+    whether telemetry can default on; target < 0.05
+    (tests/test_observability.py). ``overhead`` is a fraction (0.03 =
+    3% slower); the compile/warm split rides along from the
+    telemetry-off run."""
+    import tempfile
+
     import trino_tpu  # noqa: F401
     from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
-    from trino_tpu.runner import LocalQueryRunner
+    from trino_tpu.config import CONFIG
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    from trino_tpu.server.task_worker import TaskWorkerServer
     from trino_tpu.session import Session
 
-    def cold_best(collect: bool):
-        r = LocalQueryRunner(
-            session=Session(catalog="tpch", schema=schema),
-            collect_node_stats=collect)
-        return _cold_warm(lambda: r.execute(TPCH_QUERIES[1]), iters)
+    workers = [TaskWorkerServer().start() for _ in range(2)]
+    uris = [w.base_uri for w in workers]
+    sink = os.path.join(tempfile.mkdtemp(prefix="bench_otlp_"),
+                        "traces.jsonl")
+    old_file = CONFIG.otlp_file
+    try:
+        def cold_best(collect: bool):
+            # OTLP export rides ONLY the telemetry-on side: the
+            # overhead number prices tracing + attribution + export
+            # together, against a genuinely dark baseline
+            CONFIG.otlp_file = sink if collect else ""
+            r = DistributedHostQueryRunner(
+                uris, session=Session(catalog="tpch", schema=schema),
+                collect_node_stats=collect)
+            return _cold_warm(lambda: r.execute(TPCH_QUERIES[1]),
+                              iters)
 
-    off_cold, off = cold_best(False)
-    _, on = cold_best(True)
-    return dict({"overhead": max(on / off - 1.0, 0.0)},
+        off_cold, off = cold_best(False)
+        _, on = cold_best(True)
+        try:
+            with open(sink) as f:
+                exports = sum(1 for _ in f)
+        except OSError:
+            exports = 0
+    finally:
+        CONFIG.otlp_file = old_file
+        for w in workers:
+            w.stop()
+    return dict({"overhead": max(on / off - 1.0, 0.0),
+                 "otlp_exports": exports},
                 **_cw_keys(off_cold, off))
 
 
@@ -871,6 +900,10 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False):
                 vals["task_retries"] = d["task_retries_total"]
             if "query_peak_memory_bytes" in d:
                 vals["peak_memory_bytes"] = d["query_peak_memory_bytes"]
+            # telemetry leg ride-along: OTLP documents the file sink
+            # actually accepted during the telemetry-on runs
+            if "otlp_exports" in d:
+                vals["telemetry_otlp_exports"] = d["otlp_exports"]
         elif "error" in d:
             errs[d.get("leg", "?")] = d["error"]
     if err_note:
@@ -1036,12 +1069,18 @@ def main():
             dev_vals.get("warm_warm_s",
                          cpu_vals.get("warm_warm_s", 0.0)) or 0.0, 4),
         "device_budget_cap_s": round(DEV_CAP, 1),
-        # observability-regression tripwire: q1 with per-node stats
-        # collection on vs off (obs/ subsystem); device preferred,
-        # CPU fallback — target < 0.05 (tests/test_observability.py)
+        # observability-regression tripwire: q1 on the DEFAULT
+        # distributed MPP path with the full telemetry stack
+        # (tracing + device/CPU attribution + OTLP export) on vs off;
+        # device preferred, CPU fallback — target < 0.05
+        # (tests/test_observability.py)
         "telemetry_overhead": round(
             dev_vals.get("telemetry",
                          cpu_vals.get("telemetry", 0.0)) or 0.0, 4),
+        "telemetry_otlp_exports": int(
+            dev_vals.get("telemetry_otlp_exports",
+                         cpu_vals.get("telemetry_otlp_exports", 0))
+            or 0),
         # fault-tolerant execution (trino_tpu/fte/): fractional
         # slowdown of the same distributed query with one injected
         # worker failure under retry_policy=TASK, plus the scrape-side

@@ -418,3 +418,48 @@ def test_telemetry_overhead_under_5_percent():
     overhead = best[True] / best[False] - 1.0
     assert overhead < 0.05, \
         f"telemetry overhead {overhead:.1%} exceeds 5%"
+
+
+@pytest.mark.slow
+def test_telemetry_overhead_under_5_percent_distributed_mpp(tmp_path):
+    """The PR 15 re-run of the overhead bound on the DEFAULT
+    (multistage MPP) distributed path with the FULL telemetry stack
+    on: distributed tracing (traceparent propagation + id-preserving
+    span merge), device/CPU attribution, and OTLP file export —
+    mirrors bench.py's rebuilt telemetry leg. Interleaved best-of-N
+    as above."""
+    import time as _time
+    from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
+    from trino_tpu.config import CONFIG
+    from trino_tpu.exec.remote import DistributedHostQueryRunner
+    from trino_tpu.server.task_worker import TaskWorkerServer
+    sql = TPCH_QUERIES[1]
+    workers = [TaskWorkerServer().start() for _ in range(2)]
+    uris = [w.base_uri for w in workers]
+    sink = str(tmp_path / "otlp.jsonl")
+    old_file = CONFIG.otlp_file
+    try:
+        runners = {
+            collect: DistributedHostQueryRunner(
+                uris, session=Session(catalog="tpch", schema="sf1"),
+                collect_node_stats=collect)
+            for collect in (False, True)}
+        for r in runners.values():
+            r.execute(sql)                # warm: generate + compile
+        best = {False: float("inf"), True: float("inf")}
+        for _ in range(5):
+            for collect, r in runners.items():
+                CONFIG.otlp_file = sink if collect else ""
+                t0 = _time.perf_counter()
+                r.execute(sql)
+                best[collect] = min(best[collect],
+                                    _time.perf_counter() - t0)
+        overhead = best[True] / best[False] - 1.0
+        assert overhead < 0.05, \
+            f"MPP telemetry overhead {overhead:.1%} exceeds 5%"
+        # export really ran on the telemetry-on side
+        assert sum(1 for _ in open(sink)) >= 5
+    finally:
+        CONFIG.otlp_file = old_file
+        for w in workers:
+            w.stop()

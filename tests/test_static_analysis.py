@@ -610,3 +610,86 @@ def test_lint_cli_fail_on_flag(tmp_path, capsys):
     clean.write_text("x = 1\n")
     assert main([str(clean), "--fail-on", "warning"]) == 0
     capsys.readouterr()
+
+
+# --------------------------------------------------------------------------
+# lint: metrics hygiene (PR 15)
+# --------------------------------------------------------------------------
+
+def test_metrics_hygiene_flags_missing_help_and_bad_names():
+    src = textwrap.dedent('''
+        from trino_tpu.obs.metrics import METRICS
+
+        A = METRICS.counter("trino_tpu_good_total", "documented")
+        B = METRICS.counter("trino_tpu_nohelp_total")
+        C = METRICS.counter("bad_prefix_total", "has help")
+        D = METRICS.counter("trino_tpu_not_a_counter", "has help")
+        E = METRICS.histogram("trino_tpu_latency", "has help")
+        F = METRICS.gauge("trino_tpu_thing", "has help")
+        G = METRICS.gauge("trino_tpu_pool_bytes", "has help")
+        H = METRICS.counter("trino_tpu_emptyhelp_total", "")
+        _HELP = "documented elsewhere"
+        I = METRICS.counter("trino_tpu_varhelp_total", _HELP)
+    ''')
+    findings = [f for f in lint_source(src, "m.py")
+                if f.rule.startswith("metric")]
+    by_rule = {}
+    for f in findings:
+        by_rule.setdefault(f.rule, []).append(f.message)
+    missing = by_rule.get("metric-missing-help", [])
+    assert any("trino_tpu_nohelp_total" in m for m in missing)
+    assert any("trino_tpu_emptyhelp_total" in m for m in missing)
+    # non-literal help (a name) is out of the rule's reach, not flagged
+    assert not any("trino_tpu_varhelp_total" in m for m in missing)
+    naming = " ".join(by_rule.get("metric-naming", []))
+    assert "bad_prefix_total" in naming          # prefix rule
+    assert "trino_tpu_not_a_counter" in naming   # counter _total rule
+    assert "trino_tpu_latency" in naming         # histogram unit rule
+    assert "'trino_tpu_thing'" in naming         # gauge unit rule
+    # the clean families stay clean
+    assert "trino_tpu_good_total" not in naming
+    assert "trino_tpu_pool_bytes" not in naming
+
+
+def test_metrics_hygiene_ignores_local_registries():
+    # only the process singleton (METRICS/_METRICS) is in scope:
+    # test-local registries register short undocumented names freely
+    src = textwrap.dedent('''
+        from trino_tpu.obs.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        c = reg.counter("t2_total", "")
+    ''')
+    findings = [f for f in lint_source(src, "r.py")
+                if f.rule.startswith("metric")]
+    assert findings == [], findings
+
+
+def test_metrics_hygiene_duplicate_registration_across_files(tmp_path):
+    a = tmp_path / "a.py"
+    b = tmp_path / "b.py"
+    a.write_text(textwrap.dedent('''
+        from trino_tpu.obs.metrics import METRICS
+        X = METRICS.counter("trino_tpu_dup_total", "first home")
+    '''))
+    b.write_text(textwrap.dedent('''
+        from trino_tpu.obs.metrics import METRICS
+        Y = METRICS.counter("trino_tpu_dup_total", "second home")
+    '''))
+    findings = [f for f in lint_paths([str(a), str(b)])
+                if f.rule == "metric-duplicate-registration"]
+    assert len(findings) == 1
+    # the finding lands at the LATER site and names the first
+    assert findings[0].path == str(b)
+    assert "a.py" in findings[0].message
+
+
+def test_metrics_hygiene_duplicate_within_one_file():
+    src = textwrap.dedent('''
+        from trino_tpu.obs.metrics import METRICS
+        X = METRICS.counter("trino_tpu_twice_total", "one")
+        Y = METRICS.counter("trino_tpu_twice_total", "two")
+    ''')
+    findings = [f for f in lint_source(src, "dup.py")
+                if f.rule == "metric-duplicate-registration"]
+    assert len(findings) == 1 and findings[0].line == 4

@@ -459,9 +459,14 @@ def test_busy_shed_declines_then_retry_absorbs():
     gate = threading.Event()
     cats = _gated_tpch_catalogs(gate, "lineitem")
     # one runner, shed at 1 open task: the first (blocked) task
-    # saturates the worker
+    # saturates the worker. ema_s=0 pins the shed signal to the spot
+    # open-task count — this test drives an instant saturation, which
+    # the default EMA smoothing (deliberately) rides through; the EMA
+    # behavior itself is unit-tested with a deterministic clock in
+    # test_busy_shed_ema_smooths_bursts
     busy = TaskWorkerServer(catalogs=cats, task_runners=1,
-                            busy_shed_factor=1).start()
+                            busy_shed_factor=1,
+                            busy_shed_ema_s=0).start()
     healthy = TaskWorkerServer(catalogs=cats).start()
     rejects = METRICS.counter("trino_tpu_worker_busy_rejections_total")
     r0 = rejects.value()

@@ -57,6 +57,19 @@ them):
   never be pre-compiled. The rule keeps every cache-eligible program
   AOT-lowerable.
 
+**Metrics hygiene** (every module registering on the process
+registry ``METRICS``/``_METRICS``):
+
+- ``metric-missing-help`` (error): a family registered with no help
+  text — the exposition's only documentation.
+- ``metric-naming`` (error): the ``trino_tpu_`` prefix plus the
+  per-kind unit-suffix convention (counters ``_total``, histograms
+  ``_seconds``/``_bytes``/..., gauges a unit or counted-noun suffix).
+- ``metric-duplicate-registration`` (error, multi-file runs): one
+  family registered from two call sites — get-or-create makes it
+  legal at runtime, but duplicate definitions drift; define once
+  (obs/metrics.py) and import.
+
 **Suppressions** — one line at a time, with a reason::
 
     self.ended = time.time()  # tt-lint: ignore[race-attr-write] terminal-transition winner is the sole writer
@@ -261,7 +274,11 @@ _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   # PR 14: the shared split scheduler — runner/task/
                   # status threads all mutate its queues, so the race
                   # detector must see every state write
-                  "exec/taskexec.py")
+                  "exec/taskexec.py",
+                  # PR 15: the OTLP exporter — query threads and the
+                  # coordinator's HTTP threads both drive export/
+                  # serialization, so its sink state stays reachable
+                  "obs/otlp.py")
 
 
 class _CrossIndex:
@@ -644,6 +661,144 @@ class _JitAnalyzer:
             getattr(node, "col_offset", 0), rule, severity, message))
 
 
+# --------------------------------------------------------------------------
+# metrics hygiene
+# --------------------------------------------------------------------------
+
+# registrations against the process registry only: the singleton's
+# canonical names (obs/metrics.py METRICS, imported as _METRICS in
+# exec/executor.py). Local test registries (reg = MetricsRegistry())
+# are deliberately out of scope.
+_METRIC_RECEIVERS = frozenset({"METRICS", "_METRICS"})
+_METRIC_KINDS = frozenset({"counter", "gauge", "histogram"})
+_METRIC_PREFIX = "trino_tpu_"
+# unit-suffix convention per kind (Prometheus naming): counters are
+# monotonic totals; histograms carry their unit; gauges name the
+# measured quantity (bytes/seconds/...) or the counted noun
+_HIST_SUFFIXES = ("_seconds", "_bytes", "_millis", "_nanos")
+_GAUGE_SUFFIXES = ("_bytes", "_seconds", "_ratio", "_depth",
+                   "_queries", "_workers", "_shapes", "_tasks",
+                   "_entries", "_chunks")
+
+
+@dataclass
+class _MetricReg:
+    name: str
+    kind: str
+    path: str
+    line: int
+    col: int
+
+
+class _MetricsAnalyzer:
+    """Metrics-hygiene pass (gated in tier-1 next to the race/jit
+    rules): every family on the process registry must carry non-empty
+    help text (``metric-missing-help``) and follow the
+    ``trino_tpu_`` prefix + per-kind unit-suffix naming convention
+    (``metric-naming``). Registrations are also collected so the
+    driver can flag the same family registered from two call sites
+    (``metric-duplicate-registration``) — get-or-create makes that
+    legal at runtime, but two definitions of one identity WILL drift
+    (help text, labels), so the convention is one definition imported
+    everywhere (the PR 12 stream families pattern)."""
+
+    def __init__(self, tree: ast.Module, path: str):
+        self.tree = tree
+        self.path = path
+        self.findings: List[Finding] = []
+        self.registrations: List[_MetricReg] = []
+
+    def analyze(self) -> List[Finding]:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute) \
+                    or node.func.attr not in _METRIC_KINDS:
+                continue
+            recv = (_dotted(node.func.value) or "").split(".")[-1]
+            if recv not in _METRIC_RECEIVERS:
+                continue
+            kind = node.func.attr
+            if not node.args or not isinstance(node.args[0],
+                                               ast.Constant) \
+                    or not isinstance(node.args[0].value, str):
+                continue    # dynamic name: out of the rule's reach
+            name = node.args[0].value
+            self.registrations.append(_MetricReg(
+                name, kind, self.path, node.lineno, node.col_offset))
+            self._check_help(node, name)
+            self._check_name(node, kind, name)
+        return self.findings
+
+    def _check_help(self, node: ast.Call, name: str) -> None:
+        help_node = None
+        if len(node.args) > 1:
+            help_node = node.args[1]
+        else:
+            for kw in node.keywords:
+                if kw.arg == "help":
+                    help_node = kw.value
+        # only ABSENT or empty-LITERAL help is a violation; a help
+        # passed as a name/call is out of the rule's reach, like the
+        # dynamic-name case above
+        bad = help_node is None or (
+            isinstance(help_node, ast.Constant)
+            and not str(help_node.value or "").strip())
+        if bad:
+            self._emit(node, "metric-missing-help",
+                       f"metric family '{name}' registered without "
+                       "help text — a scraper's only documentation")
+
+    def _check_name(self, node: ast.Call, kind: str,
+                    name: str) -> None:
+        if not name.startswith(_METRIC_PREFIX):
+            self._emit(node, "metric-naming",
+                       f"metric family '{name}' must carry the "
+                       f"'{_METRIC_PREFIX}' prefix")
+            return
+        if kind == "counter" and not name.endswith("_total"):
+            self._emit(node, "metric-naming",
+                       f"counter '{name}' must end in '_total' "
+                       "(Prometheus counter convention)")
+        elif kind == "histogram" \
+                and not name.endswith(_HIST_SUFFIXES):
+            self._emit(node, "metric-naming",
+                       f"histogram '{name}' must end in a unit "
+                       f"suffix {_HIST_SUFFIXES}")
+        elif kind == "gauge" and not name.endswith(_GAUGE_SUFFIXES):
+            self._emit(node, "metric-naming",
+                       f"gauge '{name}' must end in a unit/noun "
+                       f"suffix {_GAUGE_SUFFIXES}")
+
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(
+            self.path, getattr(node, "lineno", 0),
+            getattr(node, "col_offset", 0), rule, "error", message))
+
+
+def _metric_duplicates(regs: Sequence[_MetricReg]) -> List[Finding]:
+    """One finding per registration site beyond a family's first
+    (ordered by path then line — the first site is the canonical
+    definition the others should import)."""
+    by_name: Dict[str, List[_MetricReg]] = {}
+    for r in regs:
+        by_name.setdefault(r.name, []).append(r)
+    out: List[Finding] = []
+    for name, sites in by_name.items():
+        if len(sites) < 2:
+            continue
+        sites.sort(key=lambda r: (r.path, r.line))
+        first = sites[0]
+        for r in sites[1:]:
+            out.append(Finding(
+                r.path, r.line, r.col, "metric-duplicate-registration",
+                "error",
+                f"metric family '{name}' is already registered at "
+                f"{first.path}:{first.line} — import that definition "
+                "instead of re-registering (duplicate definitions "
+                "drift)"))
+    return out
+
+
 def _local_names(fn: ast.AST) -> Set[str]:
     """Names bound inside ``fn`` (params, assignments, loop/with
     targets, comprehension vars, local imports, nested defs)."""
@@ -705,6 +860,9 @@ def lint_source(src: str, path: str = "<string>") -> List[Finding]:
                         "syntax-error", "error", str(e))]
     findings = _RaceAnalyzer(tree, path).analyze()
     findings += _JitAnalyzer(tree, path).analyze()
+    metrics = _MetricsAnalyzer(tree, path)
+    findings += metrics.analyze()
+    findings += _metric_duplicates(metrics.registrations)
     findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return _apply_suppressions(findings, src.splitlines(), path)
 
@@ -755,11 +913,23 @@ def lint_paths(paths: Iterable[str],
             an.cross = cross
     for an in analyzers.values():
         an.analyze()
+    # metrics hygiene: per-file rules, then duplicate-registration
+    # detection ACROSS the whole run (the same family registered in
+    # two modules is exactly what a single-file pass cannot see)
+    all_regs: List[_MetricReg] = []
+    metric_findings: Dict[str, List[Finding]] = {}
+    for path in files:
+        ma = _MetricsAnalyzer(trees[path], path)
+        metric_findings[path] = ma.analyze()
+        all_regs.extend(ma.registrations)
+    for f in _metric_duplicates(all_regs):
+        metric_findings.setdefault(f.path, []).append(f)
     # collect AFTER full propagation: a caller module's analyze() may
     # have emitted findings into a callee module's analyzer
     for path in files:
         per_file = list(analyzers[path].findings)
         per_file += _JitAnalyzer(trees[path], path).analyze()
+        per_file += metric_findings.get(path, [])
         per_file.sort(key=lambda f: (f.line, f.col, f.rule))
         findings.extend(_apply_suppressions(
             per_file, sources[path].splitlines(), path))

@@ -82,8 +82,10 @@ class _Pre(PlanNode):
 @dataclass
 class NodeStats:
     """OperatorStats analog (operator/OperatorStats.java): per-plan-node
-    wall time, row/byte flow, compile (jit-trace) wall, and cache-hit
-    flags, powering EXPLAIN ANALYZE, /v1/query/{id}, and the distributed
+    wall time, row/byte flow, compile (jit-trace) wall, device time
+    (jitted-dispatch completion, distinct from wall — the tensor-
+    runtime headline split), thread-CPU time, and cache-hit flags,
+    powering EXPLAIN ANALYZE, /v1/query/{id}, and the distributed
     stats rollup (workers serialize these in task results; the
     coordinator merges them per stage — see merge_node_stats)."""
     name: str
@@ -95,6 +97,13 @@ class NodeStats:
     output_bytes: int = -1
     compile_s: float = 0.0
     cache_hit: Optional[bool] = None
+    # device seconds this node's jitted dispatches spent (block-until-
+    # ready deltas, exec/executor.py _jit_call) — own dispatches only,
+    # NOT children's (unlike wall, which nests)
+    device_s: float = 0.0
+    # thread-CPU seconds across this node's execution (includes
+    # children, like wall — the two are directly comparable)
+    cpu_s: float = 0.0
 
     def to_dict(self) -> dict:
         return {"name": self.name, "detail": self.detail,
@@ -103,7 +112,9 @@ class NodeStats:
                 "input_bytes": self.input_bytes,
                 "output_bytes": self.output_bytes,
                 "compile_s": self.compile_s,
-                "cache_hit": self.cache_hit}
+                "cache_hit": self.cache_hit,
+                "device_s": self.device_s,
+                "cpu_s": self.cpu_s}
 
     @staticmethod
     def from_dict(d: dict) -> "NodeStats":
@@ -112,7 +123,8 @@ class NodeStats:
             float(d.get("wall_s", 0.0)), int(d.get("output_rows", -1)),
             int(d.get("input_rows", -1)), int(d.get("input_bytes", -1)),
             int(d.get("output_bytes", -1)),
-            float(d.get("compile_s", 0.0)), d.get("cache_hit"))
+            float(d.get("compile_s", 0.0)), d.get("cache_hit"),
+            float(d.get("device_s", 0.0)), float(d.get("cpu_s", 0.0)))
 
 
 def _sum_counts(vals: Sequence[int]) -> int:
@@ -169,7 +181,12 @@ def merge_node_stats(
             _sum_counts([s.input_bytes for s in same]),
             _sum_counts([s.output_bytes for s in same]),
             max(s.compile_s for s in same),
-            all(hits) if hits else None))
+            all(hits) if hits else None,
+            # device/CPU are RESOURCE totals: tasks run concurrently
+            # on different devices/cores, so the stage consumed the
+            # SUM (wall takes the max — the critical path)
+            sum(s.device_s for s in same),
+            sum(s.cpu_s for s in same)))
     return merged
 
 
@@ -202,6 +219,12 @@ def stats_lines(stats: Sequence["NodeStats"]) -> List[str]:
                         if s.output_bytes >= 0 else ""))
         if s.compile_s > 0:
             parts.append(f"compile {s.compile_s * 1000:.2f}ms")
+        if s.device_s > 0:
+            # device time ≠ wall: the jitted dispatches' completion
+            # wait, the number that explains tensor-engine latency
+            parts.append(f"device {s.device_s * 1000:.2f}ms")
+        if s.cpu_s > 0:
+            parts.append(f"cpu {s.cpu_s * 1000:.2f}ms")
         if s.cache_hit is not None:
             parts.append("cache hit" if s.cache_hit else "cache miss")
         if s.detail:
@@ -231,10 +254,10 @@ _CHAIN_JIT_DENY: set = set()
 # process metrics (obs/metrics.py; scraped at GET /metrics). These are
 # per-query-phase increments, never per-row — the lock cost is noise.
 from ..obs.metrics import METRICS as _METRICS
-_M_JIT = _METRICS.counter(
-    "trino_tpu_jit_cache_total",
-    "Structural jitted-program cache lookups by cache and outcome",
-    ("cache", "result"))
+# the jit-cache family is defined ONCE in obs/metrics.py (streamjoin's
+# probe-program cache feeds the same family — a second registration
+# here would trip the metrics-hygiene lint)
+from ..obs.metrics import JIT_CACHE_LOOKUPS as _M_JIT
 _M_SCAN = _METRICS.counter(
     "trino_tpu_scan_cache_total",
     "HBM-resident scan cache lookups by granularity and outcome",
@@ -346,6 +369,18 @@ class Executor:
         # and rolled up by the remote/stage schedulers
         self.stream_chunks: int = 0
         self.stream_h2d_bytes: int = 0
+        # device-time attribution (ISSUE 15): seconds this executor's
+        # jitted dispatches spent to data-ready (_jit_call block-until-
+        # ready deltas), exported as deviceSeconds in worker task
+        # status and rolled up per stage — the number distinct from
+        # wall that explains tensor-engine latency
+        self.device_s: float = 0.0
+        # > 0 while a morsel-streamed chunk loop is driving dispatches
+        # (exec/streamjoin.py run_streamed): device timing's block-
+        # until-ready would serialize the double-buffered overlap, so
+        # streamed chunks forgo device attribution — the overlap
+        # contract outranks it
+        self._stream_depth: int = 0
         # remote-task split addressing: (part, nparts) makes every scan
         # read only splits with index % nparts == part (the worker's
         # share of a fragment — server/task_worker.py fragment payloads;
@@ -402,13 +437,18 @@ class Executor:
         A frame on the stack accumulates this node's input flow: every
         child node adds its own output rows/bytes to the parent frame
         on exit, and split reads add the scanned rows directly."""
-        frame = {"rows": 0, "bytes": 0, "compile_s": 0.0, "cache": None}
+        frame = {"rows": 0, "bytes": 0, "compile_s": 0.0, "cache": None,
+                 "device_s": 0.0}
         self._frames.append(frame)
         t0 = time.perf_counter()
+        cpu0 = time.thread_time()
         try:
             out = fn()
         finally:
             self._frames.pop()
+        # CPU before the blocking row read below: the host decode of
+        # the output is accounting overhead, not the operator's work
+        cpu_s = max(time.thread_time() - cpu0, 0.0)
         # blocking read for accurate per-node timing
         n = (out.total_rows_host() if hasattr(out, "total_rows_host")
              else out.num_rows_host())
@@ -428,7 +468,8 @@ class Executor:
                 output_rows=n,
                 input_rows=frame["rows"], input_bytes=frame["bytes"],
                 output_bytes=obytes, compile_s=frame["compile_s"],
-                cache_hit=frame["cache"]))
+                cache_hit=frame["cache"],
+                device_s=frame["device_s"], cpu_s=cpu_s))
         if self._frames:
             parent = self._frames[-1]
             parent["rows"] += n
@@ -438,20 +479,67 @@ class Executor:
     def _jit_call(self, jitted, args: tuple, cache: str, hit: bool):
         """Invoke a jitted program, separating jit_trace (first, cache-
         miss call: trace + XLA compile + execute) from device_execute
-        (steady state) in the query trace and attributing compile wall
-        to the current node's stats frame. On a tensor runtime this
-        split is the headline latency number (PAPERS.md)."""
+        (steady state) in the query trace, attributing compile wall to
+        the current node's stats frame, and measuring DEVICE time
+        distinct from wall: jax dispatch is async, so the delta from
+        dispatch return to ``jax.block_until_ready`` is the device's
+        completion wait (the fallback ISSUE 15 names; a real XLA-
+        profiler hook would refine, not replace, this number). On a
+        sync backend the dispatch itself runs the program, so a
+        cache-hit call's whole span is device work. The extra sync
+        only happens under telemetry — the stats fence next to it
+        already syncs per node, so the no-telemetry path keeps jax's
+        async pipeline untouched. AOT-compiled programs (exec/aot.py)
+        additionally surface XLA's cost analysis (flops) on the
+        span."""
         tr = self.trace
         if tr is None and not self.collect_stats:
             return jitted(*args)
         t0 = time.perf_counter()
+        t1 = dev_s = None
         try:
-            return jitted(*args)
-        finally:
+            out = jitted(*args)
             t1 = time.perf_counter()
+            if self._stream_depth == 0:
+                # device attribution syncs — inside a streamed chunk
+                # loop that sync would serialize the double-buffered
+                # transfer/compute overlap, so streamed dispatches
+                # skip it (their chunks report wall only)
+                try:
+                    jax.block_until_ready(out)
+                except Exception:   # noqa: BLE001 — non-array outputs
+                    pass
+                t2 = time.perf_counter()
+                # hit: the whole dispatch-to-ready window is device
+                # work; miss: only the post-trace completion wait is
+                # (the trace+compile share lands in compile_s below)
+                dev_s = (t2 - t0) if hit else (t2 - t1)
+            return out
+        finally:
+            tend = time.perf_counter()
+            if t1 is None:
+                t1 = tend
             if tr is not None:
+                attrs = {"cache": cache}
+                if dev_s is not None:
+                    attrs["device_ms"] = round(dev_s * 1000, 3)
+                if not hit:
+                    try:    # AOT Compiled objects carry cost analysis
+                        ca = getattr(jitted, "cost_analysis", None)
+                        if ca is not None:
+                            c = ca()
+                            c = c[0] if isinstance(c, (list, tuple)) \
+                                else c
+                            if c and c.get("flops"):
+                                attrs["flops"] = float(c["flops"])
+                    except Exception:   # noqa: BLE001 — advisory
+                        pass
                 tr.record("device_execute" if hit else "jit_trace",
-                          t0, t1, cache=cache)
+                          t0, tend, **attrs)
+            if dev_s:
+                self.device_s += dev_s
+                if self._frames:
+                    self._frames[-1]["device_s"] += dev_s
             if not hit and self._frames:
                 self._frames[-1]["compile_s"] += t1 - t0
                 if self._frames[-1]["cache"] is None:

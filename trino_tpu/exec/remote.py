@@ -133,6 +133,15 @@ class RemoteScheduler:
         self.spill_bytes = 0
         self.stream_chunks = 0
         self.stream_h2d_bytes = 0
+        # scheduler/device attribution rollup (ISSUE 15): thread-CPU
+        # seconds the workers' split schedulers accounted to this
+        # query's tasks and device seconds their jitted dispatches
+        # measured — summed per fragment/stage for the EXPLAIN ANALYZE
+        # rollup and query-wide for the result
+        self.cpu_seconds = 0.0
+        self.device_seconds = 0.0
+        self.fragment_cpu: Dict[int, float] = {}
+        self.fragment_device: Dict[int, float] = {}
         # fault-tolerant execution (trino_tpu/fte/): the heartbeat
         # detector receives observed task failures and is consulted
         # when picking a replacement worker; the spool receives every
@@ -430,6 +439,12 @@ class RemoteScheduler:
                        if nw == self.fragment_expected else
                        f"fragment {fid} x{nw}/"
                        f"{self.fragment_expected} workers reported")
+                # the per-fragment attribution rollup: scheduler-
+                # accounted CPU and device seconds, distinct from wall
+                tag += (f" [cpu {self.fragment_cpu.get(fid, 0.0):.3f}s"
+                        f", device "
+                        f"{self.fragment_device.get(fid, 0.0) * 1000:.2f}"
+                        "ms]")
                 for s in self.fragment_stats[fid]:
                     s.detail = f"{s.detail} {tag}".strip() \
                         if s.detail else tag
@@ -502,6 +517,12 @@ class RemoteScheduler:
                 tag = (f"stage {sid} x{nrep} tasks"
                        if nrep == ntasks else
                        f"stage {sid} x{nrep}/{ntasks} tasks reported")
+                # per-stage attribution (the acceptance rollup):
+                # worker-side scheduler CPU + device seconds, distinct
+                # from the wall column
+                tag += (f" [cpu {sx.stage_cpu.get(sid, 0.0):.3f}s, "
+                        f"device "
+                        f"{sx.stage_device.get(sid, 0.0) * 1000:.2f}ms]")
                 for s in sx.stage_stats[sid]:
                     s.detail = f"{s.detail} {tag}".strip() \
                         if s.detail else tag
@@ -638,6 +659,14 @@ class RemoteScheduler:
                     st.running_since = t0
                     st.running_worker = wi
             beat = self._live_memory_hook(tid)
+            # distributed tracing: pre-mint THIS attempt's span id and
+            # ship it W3C-style — the worker's spans are born with the
+            # query's trace id and this id as their parent, so the
+            # post-completion graft is an id-preserving merge
+            span_id = tp = None
+            if trace is not None:
+                span_id = trace.new_span_id()
+                tp = trace.traceparent(span_id)
             try:
                 client.submit_fragment(
                     tid, payloads[f.fid],
@@ -657,7 +686,8 @@ class RemoteScheduler:
                                            None),
                     group_weight=getattr(session,
                                          "resource_group_weight",
-                                         None))
+                                         None),
+                    traceparent=tp)
                 # the watch event aborts this attempt's page pull the
                 # moment a sibling attempt wins (or the user cancels)
                 watch = _MultiEvent(getattr(session, "cancel", None),
@@ -670,7 +700,8 @@ class RemoteScheduler:
                     meta_out=meta,
                     # 202 polls carry the running task's live
                     # reservation into the cluster pool
-                    on_beat=beat)
+                    on_beat=beat,
+                    traceparent=tp)
             except Exception as e:     # noqa: BLE001
                 st.last_window = (t0, _time.perf_counter())
                 if not speculative:
@@ -787,7 +818,7 @@ class RemoteScheduler:
                 # status GET error, graft bug) must never fail the
                 # query
                 if self.collect_stats:
-                    status = client.status(tid)
+                    status = client.status(tid, traceparent=tp)
                     # the worker's compiled-shape delta feeds the
                     # coordinator's hot-shape registry: DISPATCHED
                     # fragments' programs become pre-warmable even
@@ -803,17 +834,29 @@ class RemoteScheduler:
                     worker_resources.append((
                         int(status.get("peakMemoryBytes") or 0),
                         int(status.get("spillBytes") or 0)))
+                    cpu_s = float(status.get("cpuSeconds") or 0.0)
+                    dev_s = float(status.get("deviceSeconds") or 0.0)
                     with self._stats_lock:
                         self.stream_chunks += int(
                             status.get("streamChunks") or 0)
                         self.stream_h2d_bytes += int(
                             status.get("streamH2dBytes") or 0)
+                        self.cpu_seconds += cpu_s
+                        self.device_seconds += dev_s
+                        self.fragment_cpu[f.fid] = \
+                            self.fragment_cpu.get(f.fid, 0.0) + cpu_s
+                        self.fragment_device[f.fid] = \
+                            self.fragment_device.get(f.fid, 0.0) + dev_s
                     if trace is not None:
+                        # the pre-minted id becomes the span the
+                        # worker's subtree already points at
                         sp = trace.record(
                             f"fragment_{f.fid}_execute", t0, t1,
-                            parent=trace_parent, worker=wi,
-                            task=tid, attempt=attempt,
-                            speculative=speculative)
+                            parent=trace_parent, span_id=span_id,
+                            worker=wi, task=tid, attempt=attempt,
+                            speculative=speculative,
+                            cpu_s=round(cpu_s, 6),
+                            device_ms=round(dev_s * 1000, 3))
                         trace.graft(sp, status.get("spans") or [])
                 # a remote task IS this engine's split of work: its
                 # completion is the SplitCompleted lifecycle event
@@ -1249,6 +1292,14 @@ class DistributedHostQueryRunner:
             # finally for the same reason: failed/timed-out queries
             # must not vanish from the SLO dashboards
             QUERY_WALL_SECONDS.observe(_time.perf_counter() - t0)
+            # OTLP export (obs/otlp.py): the finished distributed
+            # trace — worker spans included, ids intact — leaves
+            # through the configured sinks; in the finally so failed
+            # queries' traces export too (they are the ones worth
+            # reading)
+            if trace is not None and trace.roots:
+                from ..obs.otlp import maybe_export
+                maybe_export(trace, session=self.session)
         if collect:
             # sched.peak_memory_bytes is only populated when worker
             # stats were fetched; a non-stats query must not clobber
@@ -1277,6 +1328,8 @@ class DistributedHostQueryRunner:
         res.spill_bytes = sched.spill_bytes
         res.stream_chunks = sched.stream_chunks
         res.stream_h2d_bytes = sched.stream_h2d_bytes
+        res.cpu_seconds = sched.cpu_seconds
+        res.device_seconds = sched.device_seconds
         if self.collect_node_stats:
             res.stats = sched.stats
         return res

@@ -65,17 +65,13 @@ import numpy as np
 
 from ..columnar import Batch, Column, empty_batch
 from ..config import CONFIG, capacity_for
-from ..obs.metrics import (METRICS, STREAM_CHUNKS, STREAM_H2D_BYTES,
+from ..obs.metrics import (JIT_CACHE_LOOKUPS as _M_JIT, METRICS,
+                           STREAM_CHUNKS, STREAM_H2D_BYTES,
                            STREAM_OVERLAPPED)
 from ..plan.nodes import (FilterNode, JoinNode, PlanNode, ProjectNode,
                           RemoteSourceNode, TableScanNode)
 from ..rex import Call as _RCall, InputRef, and_all
 from ..types import BOOLEAN, DecimalType
-
-_M_JIT = METRICS.counter(
-    "trino_tpu_jit_cache_total",
-    "Structural jitted-program cache lookups by cache and outcome",
-    ("cache", "result"))
 
 # cross-query cache of jitted streamed-join probe programs, keyed by
 # (probe/build lane specs, keys, join type, residual, capacities);
@@ -325,10 +321,24 @@ def run_streamed(ex, op: str, host_iter: Iterable[Batch],
     compute (the double-buffer contract). Returns (chunks, h2d bytes)
     and records them in the stream metrics + the executor's per-query
     counters + the current stats frame."""
+    it = iter(host_iter)
+    # device-timing suppression: _jit_call's block-until-ready device
+    # attribution would serialize this loop's double-buffered overlap
+    # — streamed dispatches run unsynced (wall-only spans)
+    ex._stream_depth += 1
+    try:
+        return _stream_loop(ex, op, it, dispatch, collect)
+    finally:
+        ex._stream_depth -= 1
+
+
+def _stream_loop(ex, op: str, it, dispatch,
+                 collect) -> Tuple[int, int]:
+    """The body of ``run_streamed`` (split out so the device-timing
+    suppression wraps it in one try/finally)."""
     import time as _time
     from contextlib import nullcontext
     trace = ex.trace
-    it = iter(host_iter)
     host = next(it, None)
     nchunks = h2d = overlapped = 0
     cur = None

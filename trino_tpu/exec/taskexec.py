@@ -50,7 +50,9 @@ import time
 from bisect import bisect_right
 from typing import Dict, List, Optional, Tuple
 
-from ..obs.metrics import (TASK_SCHED_QUANTA, TASK_SCHED_RUNNABLE,
+from ..obs.metrics import (EXCHANGE_WAIT_SECONDS, TASK_QUANTUM_SECONDS,
+                           TASK_SCHED_LEVEL_SECONDS, TASK_SCHED_QUANTA,
+                           TASK_SCHED_QUEUE_DEPTH, TASK_SCHED_RUNNABLE,
                            TASK_SCHED_YIELDS)
 
 # per-query scheduled-seconds thresholds for the feedback levels
@@ -72,7 +74,7 @@ class TaskHandle:
 
     __slots__ = ("ex", "query_id", "task_id", "group", "weight",
                  "cancel", "seq", "state", "_grant_ev", "_since",
-                 "quanta")
+                 "quanta", "cpu_s", "_cpu_since")
 
     def __init__(self, ex: "TaskExecutor", query_id: str, task_id: str,
                  group: str, weight: float, cancel, seq: int):
@@ -87,6 +89,14 @@ class TaskHandle:
         self._grant_ev = threading.Event()
         self._since: float = 0.0    # clock() at the last grant/account
         self.quanta = 0
+        # scheduler CPU attribution: per-thread CPU seconds
+        # (time.thread_time) accumulated quantum by quantum — every
+        # stamp happens ON the task's own thread (checkpoint / blocked
+        # / close run there; grants re-stamp in _wait_grant after the
+        # waiting thread wakes), so the delta is exactly this task's
+        # thread CPU between checkpoints, per (query, task, split)
+        self.cpu_s = 0.0
+        self._cpu_since: float = time.thread_time()
 
     # -- the lifecycle entry points -----------------------------------
     def __enter__(self) -> "TaskHandle":
@@ -171,44 +181,67 @@ class TaskHandle:
         now = self.ex._clock()
         elapsed = max(now - self._since, 0.0)
         self._since = now
-        self.ex._charge_locked(self, elapsed)
+        # CPU stamp on the owning thread (every _account_locked caller
+        # runs on the task thread): the quantum's thread-CPU seconds
+        cpu_now = time.thread_time()
+        cpu = max(cpu_now - self._cpu_since, 0.0)
+        self._cpu_since = cpu_now
+        self.cpu_s += cpu
+        self.ex._charge_locked(self, elapsed, cpu)
 
     def _wait_grant(self) -> None:
         ex = self.ex
-        while not self._grant_ev.wait(0.05):
-            if self.cancel is not None and self.cancel.is_set():
-                with ex._lock:
-                    if self.state == "running":
-                        return      # granted while we checked cancel
-                    try:
-                        ex._waiting.remove(self)
-                    except ValueError:
-                        pass
-                    self.state = "closed"
-                    ex._close_locked(self)
-                raise TaskCanceledError(
-                    f"task {self.task_id} canceled while waiting for "
-                    "a runner slot")
+        try:
+            while not self._grant_ev.wait(0.05):
+                if self.cancel is not None and self.cancel.is_set():
+                    with ex._lock:
+                        if self.state == "running":
+                            return  # granted while we checked cancel
+                        try:
+                            ex._waiting.remove(self)
+                        except ValueError:
+                            pass
+                        ex._publish_depth_locked()
+                        self.state = "closed"
+                        ex._close_locked(self)
+                    raise TaskCanceledError(
+                        f"task {self.task_id} canceled while waiting "
+                        "for a runner slot")
+        finally:
+            # CPU accounting restarts at the grant: time burned off-CPU
+            # waiting for the slot must not charge the next quantum
+            self._cpu_since = time.thread_time()  # tt-lint: ignore[race-attr-write] owning-task-thread-private: every _cpu_since reader/writer runs on the handle's own thread (thread_time is per-thread by definition)
 
 
 class _BlockedScope:
-    __slots__ = ("h",)
+    __slots__ = ("h", "_t0", "_released")
 
     def __init__(self, h: TaskHandle):
         self.h = h
+        self._t0: float = 0.0
+        self._released = False
 
     def __enter__(self):
         h, ex = self.h, self.h.ex
+        self._t0 = time.perf_counter()
         with ex._lock:
             if h.state == "running":
                 h._account_locked()
                 h.state = "blocked"
                 ex._running.discard(h)
                 ex._dispatch_locked()
+                self._released = True
         return self
 
     def __exit__(self, *exc):
         h, ex = self.h, self.h.ex
+        if self._released:
+            # the exchange-wait observable: how long this consumer sat
+            # off-CPU with its runner slot RELEASED waiting for
+            # upstream commits (a no-op enter — closed/canceled handle
+            # — held no slot and must not skew the histogram)
+            EXCHANGE_WAIT_SECONDS.observe(
+                max(time.perf_counter() - self._t0, 0.0))
         with ex._lock:
             if h.state != "blocked":
                 return              # closed while blocked
@@ -225,7 +258,8 @@ class TaskExecutor:
     registration is unbounded (admission/shedding is the caller's
     concern — server/task_worker.py)."""
 
-    def __init__(self, runners: int, clock=time.perf_counter):
+    def __init__(self, runners: int, clock=time.perf_counter,
+                 ema_tau_s: Optional[float] = None):
         self.runners = max(1, int(runners))
         self._clock = clock
         self._lock = threading.Lock()
@@ -235,8 +269,19 @@ class TaskExecutor:
         # (time drops with the query's last handle — qids are unique
         # per dispatch, so the table stays bounded by live queries)
         self._query_time: Dict[str, float] = {}
+        self._query_cpu: Dict[str, float] = {}
         self._query_handles: Dict[str, int] = {}
         self._group_time: Dict[str, float] = {}
+        # time-decayed EMA of the open-task count (the busy-shed
+        # signal, server/task_worker.py _shed_reason): a dispatch
+        # burst decays in, sustained overload saturates. tau from
+        # config (TRINO_TPU_BUSY_SHED_EMA_S); 0 tracks the spot value.
+        if ema_tau_s is None:
+            from ..config import CONFIG
+            ema_tau_s = CONFIG.busy_shed_ema_s
+        self.ema_tau_s = max(float(ema_tau_s), 0.0)
+        self._ema = 0.0
+        self._ema_t = self._clock()
         # stride scheduling per group: virtual time advances by
         # elapsed/weight; the smallest virtual time drains next, so a
         # group's share follows its WEIGHT, not its query count. The
@@ -255,9 +300,12 @@ class TaskExecutor:
             self._seq += 1
             h = TaskHandle(self, query_id, task_id, group, weight,
                            cancel, self._seq)
+            self._ema_update_locked()   # decay over the quiet window,
+            #                             THEN admit the new task
             self._query_handles[query_id] = \
                 self._query_handles.get(query_id, 0) + 1
             self._query_time.setdefault(query_id, 0.0)
+            self._query_cpu.setdefault(query_id, 0.0)
             if self._group_handles.get(group, 0) == 0:
                 # (re-)activation clamp: an idle group's virtual
                 # clock catches up to the floor of currently-active
@@ -289,6 +337,25 @@ class TaskExecutor:
         with self._lock:
             return self._query_time.get(query_id, 0.0)
 
+    def query_cpu_seconds(self, query_id: str) -> float:
+        """Accumulated thread-CPU seconds the scheduler accounted for
+        this query's quanta on this worker (the figure task status
+        reports back to the coordinator)."""
+        with self._lock:
+            return self._query_cpu.get(query_id, 0.0)
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._waiting)
+
+    def open_tasks_ema(self) -> float:
+        """Time-decayed EMA of the open-task count — the smoothed
+        busy-shed signal (reads also advance the decay, so a worker
+        going quiet recovers without waiting for the next event)."""
+        with self._lock:
+            self._ema_update_locked()
+            return self._ema
+
     def set_query_seconds(self, query_id: str, seconds: float) -> None:
         """Test hook: pin a query's accumulated scheduled time (drives
         the level/priority logic deterministically)."""
@@ -302,6 +369,20 @@ class TaskExecutor:
             self._group_vtime[group] = float(vtime)
 
     # -- internals (all called under self._lock) ----------------------
+    def _ema_update_locked(self) -> None:
+        now = self._clock()
+        dt = max(now - self._ema_t, 0.0)
+        self._ema_t = now
+        if self.ema_tau_s <= 0:
+            self._ema = float(self._open)
+            return
+        import math
+        alpha = 1.0 - math.exp(-dt / self.ema_tau_s)
+        self._ema += alpha * (float(self._open) - self._ema)
+
+    def _publish_depth_locked(self) -> None:
+        TASK_SCHED_QUEUE_DEPTH.set(len(self._waiting))
+
     def _key_locked(self, h: TaskHandle
                     ) -> Tuple[int, float, float, int]:
         qtime = self._query_time.get(h.query_id, 0.0)
@@ -325,22 +406,36 @@ class TaskExecutor:
             h._since = self._clock()
             self._running.add(h)
             h._grant_ev.set()
+        self._publish_depth_locked()
 
-    def _charge_locked(self, h: TaskHandle, elapsed: float) -> None:
+    def _charge_locked(self, h: TaskHandle, elapsed: float,
+                       cpu: float = 0.0) -> None:
+        # the level the quantum RAN at (pre-charge accumulated time):
+        # the per-level scheduled-seconds counter is the decay ladder's
+        # observable face
+        level = bisect_right(LEVEL_THRESHOLDS_S,
+                             self._query_time.get(h.query_id, 0.0))
         self._query_time[h.query_id] = \
             self._query_time.get(h.query_id, 0.0) + elapsed
+        self._query_cpu[h.query_id] = \
+            self._query_cpu.get(h.query_id, 0.0) + cpu
         self._group_time[h.group] = \
             self._group_time.get(h.group, 0.0) + elapsed
         self._group_vtime[h.group] = \
             self._group_vtime.get(h.group, 0.0) + elapsed / h.weight
         h.quanta += 1
         TASK_SCHED_QUANTA.inc(group=h.group)
+        TASK_QUANTUM_SECONDS.observe(elapsed)
+        TASK_SCHED_LEVEL_SECONDS.inc(elapsed, level=str(level))
 
     def _close_locked(self, h: TaskHandle) -> None:
+        self._ema_update_locked()   # decay over the lived window,
+        #                             THEN retire the task
         n = self._query_handles.get(h.query_id, 1) - 1
         if n <= 0:
             self._query_handles.pop(h.query_id, None)
             self._query_time.pop(h.query_id, None)
+            self._query_cpu.pop(h.query_id, None)
         else:
             self._query_handles[h.query_id] = n
         self._group_handles[h.group] = \

@@ -9,12 +9,18 @@ Here the same three layers exist TPU-first:
 - ``obs.metrics``: process-wide counters/gauges/histograms with
   Prometheus text exposition (GET /metrics on the coordinator and the
   task worker) — the JMX/MBean analog.
-- ``obs.trace``: a per-query span tree (parse -> plan -> optimize ->
-  execute, with jit_trace vs device_execute children) — on a tensor
-  runtime compilation/dispatch overheads dominate (PAPERS.md "Query
+- ``obs.trace``: a per-query DISTRIBUTED span tree (parse -> plan ->
+  optimize -> execute, with jit_trace vs device_execute children) —
+  every span carries a real 128-bit-trace/64-bit-span identity, W3C
+  ``traceparent`` context propagates into worker task payloads, and
+  worker subtrees merge back id-preserving. On a tensor runtime
+  compilation/dispatch overheads dominate (PAPERS.md "Query
   Processing on Tensor Computation Runtimes"), so trace-vs-execute
-  separation is the single most important measurement the JVM engine
-  never needed.
+  separation (and device_ms vs wall) is the single most important
+  measurement the JVM engine never needed.
+- ``obs.otlp``: stdlib-only OTLP/JSON export of finished traces
+  (ResourceSpans shape; file + HTTP sinks, plus the coordinator's
+  GET /v1/trace/{query_id} pull surface).
 - rich ``NodeStats`` + the distributed rollup live with the executor
   (exec/executor.py, exec/remote.py): workers report per-node stats in
   task results and the coordinator merges them per stage.

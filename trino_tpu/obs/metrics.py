@@ -420,6 +420,41 @@ REPLICATE_CACHE = METRICS.counter(
     "Per-worker fetch-once cache lookups on replicate exchange "
     "edges, by outcome", ("result",))
 
+# structural jitted-program caches (exec/executor.py chain/stream/
+# masked programs + exec/streamjoin.py probe programs): ONE family
+# definition here so the two producer modules cannot drift into
+# duplicate registrations of the same name
+JIT_CACHE_LOOKUPS = METRICS.counter(
+    "trino_tpu_jit_cache_total",
+    "Structural jitted-program cache lookups by cache and outcome",
+    ("cache", "result"))
+
+# distributed tracing + scheduler attribution (ISSUE 15): the
+# worker-side split scheduler's observables (exec/taskexec.py) and the
+# OTLP trace exporter (obs/otlp.py). Registered here — not in the
+# lazily imported producer modules — so scrapes, the bench telemetry
+# leg, and the EMA busy-shed all read one family identity.
+TASK_SCHED_QUEUE_DEPTH = METRICS.gauge(
+    "trino_tpu_task_scheduler_queue_depth",
+    "Tasks waiting for a runner slot in the shared split scheduler "
+    "(the backlog the EMA busy-shed smooths)")
+TASK_QUANTUM_SECONDS = METRICS.histogram(
+    "trino_tpu_task_quantum_seconds",
+    "Wall seconds per scheduler quantum (the work between two "
+    "split/chunk checkpoints)")
+EXCHANGE_WAIT_SECONDS = METRICS.histogram(
+    "trino_tpu_exchange_wait_seconds",
+    "Wall seconds a consumer task spent blocked on upstream exchange "
+    "commits with its runner slot released")
+TASK_SCHED_LEVEL_SECONDS = METRICS.counter(
+    "trino_tpu_task_scheduled_seconds_total",
+    "Scheduled wall seconds accounted by the shared split scheduler, "
+    "by multilevel-feedback level at grant time", ("level",))
+OTLP_EXPORTS = METRICS.counter(
+    "trino_tpu_otlp_exports_total",
+    "OTLP trace-export attempts by sink and outcome (obs/otlp.py "
+    "file/HTTP sinks)", ("sink", "result"))
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

@@ -153,6 +153,11 @@ class LocalQueryRunner:
             # queries are exactly the ones that time out, and a latency
             # histogram that drops them reads optimistic at p99
             QUERY_WALL_SECONDS.observe(time.perf_counter() - t0)
+            # OTLP export (obs/otlp.py): best-effort, sink-configured
+            # — in the finally so failed queries' traces export too
+            if trace is not None and trace.roots:
+                from .obs.otlp import maybe_export
+                maybe_export(trace, session=self.session)
         result.query_id = qid
         result.wall_s = time.perf_counter() - t0
         result.trace = trace

@@ -1252,6 +1252,26 @@ def _make_handler(co: Coordinator):
                 self._send(200, {"shapes": HOT_SHAPES.top(k),
                                  "tracked": len(HOT_SHAPES)})
                 return
+            if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
+                # the finished query's distributed trace as OTLP/JSON
+                # (obs/otlp.py ResourceSpans shape) — the pull surface
+                # of the export: worker spans share the query's trace
+                # id with their true parent span ids, no collector
+                # required. 404 until the query has a trace (still
+                # running, untraced, or unknown id).
+                q = co.tracker.get(parts[2])
+                trace = (getattr(q.result, "trace", None)
+                         if q is not None and q.result is not None
+                         else None)
+                if trace is None or not trace.roots:
+                    self._send(404, {"error": "no trace for query"})
+                    return
+                from ..obs.otlp import trace_to_resource_spans
+                self._send(200, trace_to_resource_spans(
+                    trace, {"trino_tpu.query_id": q.query_id,
+                            "trino_tpu.state": q.state,
+                            "service.name": "trino_tpu-coordinator"}))
+                return
             if len(parts) == 3 and parts[:2] == ["v1", "query"]:
                 q = co.tracker.get(parts[2])
                 if q is None:

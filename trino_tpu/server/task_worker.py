@@ -174,6 +174,19 @@ class _Task:
         # schedulers next to peak memory
         self.stream_chunks = 0
         self.stream_h2d_bytes = 0
+        # scheduler + device attribution (ISSUE 15): thread-CPU
+        # seconds the shared split scheduler accounted to this task's
+        # quanta (exec/taskexec.py TaskHandle.cpu_s; falls back to a
+        # raw thread_time delta without a scheduler) and device
+        # seconds the executor's jitted dispatches measured — both
+        # ride task status so the coordinator rolls them into the
+        # trace and the EXPLAIN ANALYZE stage rollup
+        self.cpu_seconds = 0.0
+        self.device_seconds = 0.0
+        # distributed tracing: the query's 128-bit trace id this
+        # task's spans were born with (from the traceparent the
+        # payload carried); None when the task was untraced
+        self.trace_id: Optional[str] = None
         self.done = threading.Event()
         # coordinator-side abort (DELETE /v1/task): flips the running
         # task's cooperative cancel — the executor stops between plan
@@ -183,9 +196,11 @@ class _Task:
         self.cancel_ev = threading.Event()
 
     def run(self, payload: dict):
+        import time as _time
         from ..exec.hotshapes import HOT_SHAPES
         shapes_before = HOT_SHAPES.hit_counts()
         handle = None
+        cpu0 = _time.thread_time()
         try:
             from ..runner import LocalQueryRunner
             from ..session import Session
@@ -248,7 +263,21 @@ class _Task:
                 # another worker like any task error)
                 from ..analysis.sanity import PlanSanityChecker
                 PlanSanityChecker().validate(plan, "worker-decode")
-                trace = QueryTrace(self.task_id) if collect else None
+                # distributed tracing (ISSUE 15): the task payload
+                # carries a W3C traceparent naming the query's trace
+                # id and the coordinator's pre-minted span id for THIS
+                # task — worker spans are born inside the query's
+                # trace with their true parent, so the coordinator's
+                # graft is an id-preserving merge, not a clock rebase
+                trace = None
+                if collect:
+                    ctx = QueryTrace.parse_traceparent(
+                        payload.get("traceparent"))
+                    trace = QueryTrace(
+                        self.task_id,
+                        trace_id=ctx[0] if ctx else None,
+                        parent_span_id=ctx[1] if ctx else None)
+                    self.trace_id = trace.trace_id  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 session.trace = trace
                 ex = Executor(runner.catalogs, session,
                               collect_stats=collect)
@@ -295,6 +324,7 @@ class _Task:
                 self.spill_bytes = ex.spilled_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.stream_chunks = ex.stream_chunks  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.stream_h2d_bytes = ex.stream_h2d_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.device_seconds = ex.device_s  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             else:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
@@ -347,6 +377,14 @@ class _Task:
             if handle is not None:
                 handle.close()      # release the runner slot + the
                 #                     scheduler's per-query accounting
+                # scheduler-accounted CPU: the sum of this task's
+                # quantum stamps (finalized by close() above)
+                self.cpu_seconds = float(handle.cpu_s)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+            else:
+                # schedulerless embedding: the raw thread-CPU delta of
+                # the whole run is the best available figure
+                self.cpu_seconds = max(  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                    _time.thread_time() - cpu0, 0.0)
             try:
                 # hit-count DELTAS since this task started: concurrent
                 # tasks may each claim a shared sighting (their deltas
@@ -367,7 +405,8 @@ class TaskWorkerServer:
     def __init__(self, port: int = 0, spool_dir: Optional[str] = None,
                  spool_backend: Optional[str] = None, catalogs=None,
                  task_runners: Optional[int] = None,
-                 busy_shed_factor: Optional[int] = None):
+                 busy_shed_factor: Optional[int] = None,
+                 busy_shed_ema_s: Optional[float] = None):
         self._tasks: Dict[str, _Task] = {}
         self._lock = threading.Lock()
         # shared split scheduler (exec/taskexec.py): ONE bounded
@@ -382,7 +421,10 @@ class TaskWorkerServer:
              else int(CONFIG.task_runner_threads))
         if n <= 0:
             n = max(4, 2 * (_os.cpu_count() or 1))
-        self.task_executor = TaskExecutor(n)
+        # busy_shed_ema_s: time constant of the queue-depth EMA the
+        # shed decision smooths through (0 = spot value, the pre-EMA
+        # behavior tests pin; default CONFIG.busy_shed_ema_s)
+        self.task_executor = TaskExecutor(n, ema_tau_s=busy_shed_ema_s)
         self.busy_shed_factor = (
             int(busy_shed_factor) if busy_shed_factor is not None
             else int(CONFIG.busy_shed_factor))
@@ -415,6 +457,13 @@ class TaskWorkerServer:
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
                     length = int(self.headers.get("Content-Length", 0))
                     payload = json.loads(self.rfile.read(length))
+                    # W3C context propagation: the traceparent rides
+                    # the HTTP header AND the payload; the header is
+                    # the fallback for payloads built by clients that
+                    # predate the field
+                    tp = self.headers.get("traceparent")
+                    if tp and "traceparent" not in payload:
+                        payload["traceparent"] = tp
                     try:
                         t = worker.create_task(parts[2], payload)
                     except WorkerBusyError as e:
@@ -582,7 +631,10 @@ class TaskWorkerServer:
                          "liveMemoryBytes": t.live_memory_bytes,
                          "spillBytes": t.spill_bytes,
                          "streamChunks": t.stream_chunks,
-                         "streamH2dBytes": t.stream_h2d_bytes}).encode()
+                         "streamH2dBytes": t.stream_h2d_bytes,
+                         "cpuSeconds": t.cpu_seconds,
+                         "deviceSeconds": t.device_seconds,
+                         "traceId": t.trace_id}).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")
                     self.send_header("Content-Length", str(len(body)))
@@ -687,17 +739,34 @@ class TaskWorkerServer:
 
     def _shed_reason(self) -> Optional[str]:
         """Non-None when this worker should decline NEW dispatches
-        with the retryable BUSY signal (graceful degradation): open
-        tasks past busy_shed_factor x runner slots, or the worker
-        memory budget breached by live reservations alone."""
+        with the retryable BUSY signal (graceful degradation): the
+        EMA-smoothed open-task count past busy_shed_factor x runner
+        slots, or the worker memory budget breached by live
+        reservations alone. The factor threshold is the FLOOR (spot
+        count must also exceed it — shedding never fires below the
+        static cap), and the EMA gate means a momentary dispatch
+        burst rides through while sustained overload still sheds
+        (PR 14 open item: the static threshold flapped on bursts)."""
         factor = int(self.busy_shed_factor or 0)
         if factor > 0:
             open_tasks = self.task_executor.open_tasks()
             cap = factor * self.task_executor.runners
+            if open_tasks >= 2 * cap:
+                # hard ceiling regardless of the EMA: the smoothing
+                # tolerates a burst WITHIN [cap, 2*cap), never an
+                # unbounded pile-up while the EMA catches up — a cold
+                # worker fanned the whole cluster's dispatch must
+                # still push back
+                return (f"{open_tasks} open tasks >= hard ceiling "
+                        f"{2 * cap} (2 x shed threshold; EMA "
+                        "smoothing does not apply)")
             if open_tasks >= cap:
-                return (f"{open_tasks} open tasks >= shed threshold "
-                        f"{cap} ({self.task_executor.runners} runners"
-                        f" x factor {factor})")
+                ema = self.task_executor.open_tasks_ema()
+                if ema >= cap:
+                    return (f"open-task EMA {ema:.1f} (spot "
+                            f"{open_tasks}) >= shed threshold {cap} "
+                            f"({self.task_executor.runners} runners "
+                            f"x factor {factor})")
         from ..config import CONFIG
         budget = int(CONFIG.worker_memory_bytes or 0)
         if budget > 0:
@@ -990,7 +1059,8 @@ class RemoteTaskClient:
                         stage: Optional[dict] = None,
                         deadline_s: Optional[float] = None,
                         resource_group: Optional[str] = None,
-                        group_weight: Optional[float] = None):
+                        group_weight: Optional[float] = None,
+                        traceparent: Optional[str] = None):
         """POST a serialized plan fragment + split share (the
         HttpRemoteTask TaskUpdateRequest analog). ``attempt`` tags the
         task's retry/speculation generation; ``spool`` asks the worker
@@ -1003,7 +1073,10 @@ class RemoteTaskClient:
         an absolute deadline for its executor. ``resource_group`` /
         ``group_weight`` carry the admitting group's identity and
         scheduling weight into the worker's shared split scheduler
-        (exec/taskexec.py fair-share drain)."""
+        (exec/taskexec.py fair-share drain). ``traceparent`` is the
+        W3C trace context naming the query's trace id and the
+        coordinator's pre-minted span id for this task (obs/trace.py)
+        — shipped both as a payload field and as the HTTP header."""
         body = {
             "fragment": fragment, "catalog": catalog, "schema": schema,
             "part": part, "nparts": nparts,
@@ -1018,18 +1091,25 @@ class RemoteTaskClient:
             body["resource_group"] = str(resource_group)
         if group_weight is not None:
             body["group_weight"] = float(group_weight)
-        return self._post(task_id, body)
+        if traceparent is not None:
+            body["traceparent"] = str(traceparent)
+        return self._post(task_id, body, traceparent=traceparent)
 
-    def status(self, task_id: str) -> dict:
+    def status(self, task_id: str,
+               traceparent: Optional[str] = None) -> dict:
         """GET the task status JSON, including worker-reported
         nodeStats and spans once the task finished."""
-        with urllib.request.urlopen(
-                f"{self.base_uri}/v1/task/{task_id}", timeout=30) as r:
+        req = urllib.request.Request(
+            f"{self.base_uri}/v1/task/{task_id}")
+        if traceparent:
+            req.add_header("traceparent", traceparent)
+        with urllib.request.urlopen(req, timeout=30) as r:
             return json.loads(r.read())
 
     def wait_done(self, task_id: str, cancel=None,
                   timeout_s: float = 600.0,
-                  poll_s: float = 0.05, on_status=None) -> dict:
+                  poll_s: float = 0.05, on_status=None,
+                  traceparent: Optional[str] = None) -> dict:
         """Poll task status until a terminal state and return the final
         status JSON (a stage task's consumers read its output off the
         spool/partition endpoint, so completion — not pages — is what
@@ -1055,7 +1135,7 @@ class RemoteTaskClient:
                     pass
                 raise RuntimeError(
                     f"task {task_id} did not finish in {timeout_s}s")
-            st = self.status(task_id)
+            st = self.status(task_id, traceparent=traceparent)
             if on_status is not None:
                 try:
                     on_status(st)
@@ -1066,18 +1146,23 @@ class RemoteTaskClient:
                 return st
             _time.sleep(poll_s)
 
-    def _post(self, task_id: str, body: dict):
+    def _post(self, task_id: str, body: dict,
+              traceparent: Optional[str] = None):
         payload = json.dumps(body).encode()
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
             f"{self.base_uri}/v1/task/{task_id}", data=payload,
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         with urllib.request.urlopen(req, timeout=30) as r:
             return json.loads(r.read())
 
     def pages_raw(self, task_id: str, cancel=None,
                   timeout_s: float = 600.0,
                   meta_out: Optional[dict] = None,
-                  on_beat=None) -> List[bytes]:
+                  on_beat=None,
+                  traceparent: Optional[str] = None) -> List[bytes]:
         """Pull every result page FRAME (token-acknowledged bounded
         poll) — raw serialized bytes, so callers can spool them without
         a decode/re-encode round trip. ``cancel`` (anything with
@@ -1117,8 +1202,13 @@ class RemoteTaskClient:
                 # not pin this pull past its budget
                 per_req = max(1.0, min(600.0,
                                        deadline - _time.monotonic()))
-                with urllib.request.urlopen(
-                        f"{self.base_uri}{path}", timeout=per_req) as r:
+                pull = urllib.request.Request(f"{self.base_uri}{path}")
+                if traceparent:
+                    # trace context on the data-plane pulls too: a
+                    # proxy/collector between hosts can correlate page
+                    # traffic with the owning query's trace
+                    pull.add_header("traceparent", traceparent)
+                with urllib.request.urlopen(pull, timeout=per_req) as r:
                     if r.status == 202:     # still running: poll again
                         if on_beat is not None:
                             # live-memory beat on the flat path: the

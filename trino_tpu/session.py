@@ -156,6 +156,12 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # + completion-time peaks (the pre-PR-14 behavior; the escape
     # hatch for tests pinning killer provenance).
     "live_memory_feedback": (bool, True),
+    # ---- distributed tracing (obs/trace.py + obs/otlp.py) ------------
+    # export this query's finished trace to the configured OTLP sinks
+    # (TRINO_TPU_OTLP_FILE / TRINO_TPU_OTLP_ENDPOINT). Off = the trace
+    # still exists (EXPLAIN ANALYZE, /v1/query, /v1/trace) but nothing
+    # leaves the process — the per-query opt-out for sensitive SQL.
+    "otlp_export": (bool, True),
 }
 
 

@@ -167,6 +167,11 @@ class StageExecution:
         self.stage_stats: Dict[int, List[NodeStats]] = {}
         self.stage_reported: Dict[int, int] = {}
         self.resources: List[Tuple[int, int]] = []   # (peak, spill)
+        # per-stage attribution sums (ISSUE 15): worker-reported
+        # scheduler CPU seconds + device seconds, summed across the
+        # stage's winning tasks (guarded by the scheduler stats lock)
+        self.stage_cpu: Dict[int, float] = {}
+        self.stage_device: Dict[int, float] = {}
 
     # -- task-count assignment ----------------------------------------
     def _assign_task_counts(self) -> None:
@@ -339,6 +344,13 @@ class StageExecution:
             if beat is not None:
                 def on_status(stt, _beat=beat):
                     _beat(stt.get("liveMemoryBytes") or 0)
+            # distributed tracing: pre-mint this attempt's span id and
+            # ship the W3C traceparent so the worker's spans are born
+            # with the query's trace id and this id as their parent
+            span_id = tp = None
+            if trace is not None:
+                span_id = trace.new_span_id()
+                tp = trace.traceparent(span_id)
             try:
                 client.submit_fragment(
                     tid, self.payloads[sid],
@@ -355,13 +367,14 @@ class StageExecution:
                                          None),
                     stage={"sid": sid, "exchange_key": st.key,
                            "nparts_out": nout,
-                           "sources": self._snapshot_sources(stage)})
+                           "sources": self._snapshot_sources(stage)},
+                    traceparent=tp)
                 watch = _Watch(getattr(session, "cancel", None),
                                st.done, self.abort)
                 status = client.wait_done(
                     tid, cancel=watch,
                     timeout_s=s._attempt_budget_s(timeout_s),
-                    on_status=on_status)
+                    on_status=on_status, traceparent=tp)
                 if status.get("state") != "FINISHED":
                     raise RuntimeError(
                         f"task is {status.get('state')}: "
@@ -427,6 +440,8 @@ class StageExecution:
                 # worker-side joins/aggregations too (exec/hotshapes)
                 from ..exec.hotshapes import HOT_SHAPES
                 HOT_SHAPES.merge(status.get("hotShapes") or [])
+                cpu_s = float(status.get("cpuSeconds") or 0.0)
+                dev_s = float(status.get("deviceSeconds") or 0.0)
                 with s._stats_lock:
                     # morsel-streaming rollup: stage tasks report
                     # their chunk counts + h2d bytes like peak memory
@@ -434,6 +449,12 @@ class StageExecution:
                         status.get("streamChunks") or 0)
                     s.stream_h2d_bytes += int(
                         status.get("streamH2dBytes") or 0)
+                    s.cpu_seconds += cpu_s
+                    s.device_seconds += dev_s
+                    self.stage_cpu[sid] = \
+                        self.stage_cpu.get(sid, 0.0) + cpu_s
+                    self.stage_device[sid] = \
+                        self.stage_device.get(sid, 0.0) + dev_s
                     self._windows.append((sid, t0, t1))
                 if speculative:
                     with s._stats_lock:
@@ -449,10 +470,15 @@ class StageExecution:
                             int(status.get("peakMemoryBytes") or 0),
                             int(status.get("spillBytes") or 0)))
                     if trace is not None:
+                        # the pre-minted id is what the worker's spans
+                        # already name as parent: id-preserving merge
                         sp = trace.record(
                             f"stage_{sid}_execute", t0, t1,
-                            parent=trace_parent, worker=wi, task=tid,
-                            attempt=attempt, speculative=speculative)
+                            parent=trace_parent, span_id=span_id,
+                            worker=wi, task=tid,
+                            attempt=attempt, speculative=speculative,
+                            cpu_s=round(cpu_s, 6),
+                            device_ms=round(dev_s * 1000, 3))
                         trace.graft(sp, status.get("spans") or [])
             except Exception:   # noqa: BLE001 — telemetry best-effort
                 pass
