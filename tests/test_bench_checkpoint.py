@@ -1,0 +1,59 @@
+"""bench.py sub-probe checkpoint: a timed-out/crashed round resumes
+where it died — completed (even PARTIAL) sub-probes replay from
+~/.cache/trino_tpu/bench_subprobes.json instead of re-burning their
+time cap, scoped to one BENCH_ROUND_ID and a TTL so a stale file can
+never masquerade as this round's progress."""
+
+import glob
+import json
+import os
+
+import pytest
+
+import bench
+
+
+@pytest.fixture(autouse=True)
+def _sandboxed_ckpt(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "_CKPT_PATH",
+                        str(tmp_path / "bench_subprobes.json"))
+    monkeypatch.setattr(bench, "_ROUND_ID", "round-a")
+    monkeypatch.setattr(bench, "_CKPT_TTL", 7200.0)
+
+
+def test_ckpt_roundtrip_same_round():
+    sub = {"cpu_baseline": {"vals": {"engine": 123.0},
+                            "errs": {}, "elapsed_s": 9.5}}
+    bench._ckpt_save(sub)
+    assert bench._ckpt_load() == sub
+
+
+def test_ckpt_round_mismatch_ignored(monkeypatch):
+    bench._ckpt_save({"cpu_baseline": {"vals": {"x": 1}}})
+    monkeypatch.setattr(bench, "_ROUND_ID", "round-b")
+    assert bench._ckpt_load() == {}
+
+
+def test_ckpt_ttl_expiry_ignored(monkeypatch):
+    bench._ckpt_save({"cpu_baseline": {"vals": {"x": 1}}})
+    monkeypatch.setattr(bench, "_CKPT_TTL", 0.0)
+    assert bench._ckpt_load() == {}
+
+
+def test_ckpt_corrupt_file_is_empty_not_fatal():
+    with open(bench._CKPT_PATH, "w") as f:
+        f.write("{not json")
+    assert bench._ckpt_load() == {}
+    # and a save over the corrupt file heals it
+    bench._ckpt_save({"device_init": {"vals": {"init": 1.0}}})
+    assert "device_init" in bench._ckpt_load()
+
+
+def test_ckpt_save_is_atomic_no_tmp_litter():
+    bench._ckpt_save({"a": {"vals": {}}})
+    bench._ckpt_save({"a": {"vals": {}}, "b": {"vals": {}}})
+    assert glob.glob(bench._CKPT_PATH + ".*.tmp") == []
+    with open(bench._CKPT_PATH) as f:
+        d = json.load(f)
+    assert d["round"] == "round-a" and set(d["subprobes"]) == {"a", "b"}

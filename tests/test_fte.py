@@ -549,6 +549,8 @@ def test_objectstore_retry_budget_exhausted_raises():
     assert spool.commit("q", 0, 0, 1, [b"x"]) == 1
 
 
+@pytest.mark.slow      # ~31s: the kill acceptance re-run with the
+# object-store spool backend; the primary kill path stays tier-1
 def test_worker_killed_with_objectstore_spool_backend(expected):
     """The PR 5 acceptance kill with the object-store-shaped spool
     active, UN-PINNED onto the default stage path (PR 14): the

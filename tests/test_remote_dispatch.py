@@ -108,6 +108,8 @@ def test_remote_q1(workers):
     _check(workers, TPCH_QUERIES[1], approx_cols=(2, 3, 4, 5, 6, 7, 8))
 
 
+@pytest.mark.slow      # ~12s; test_remote_q1 + decimal/strings keep
+# the HTTP dispatch path tier-1
 def test_remote_q3(workers):
     from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
     _check(workers, TPCH_QUERIES[3], approx_cols=(1,))

@@ -683,6 +683,8 @@ def test_pipelining_matches_barrier_and_overlaps(workers):
 # ICI-native exchange: the stage DAG on the device mesh
 # --------------------------------------------------------------------------
 
+@pytest.mark.slow      # heaviest tier-1 test (~90s); the ici_exchange
+# escape-hatch test below keeps the ICI plumbing tier-1
 def test_ici_stage_execution_matches_local():
     """The in-slice unification: LocalQueryRunner(distributed=True)
     routes fragmentable plans through the SAME stage DAG with the hash

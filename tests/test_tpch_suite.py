@@ -133,7 +133,11 @@ _HAS_ORDER = {qn: "order by" in q for qn, q in TPCH_QUERIES.items()}
 # tier 1: local vs sqlite oracle
 # --------------------------------------------------------------------------
 
-@pytest.mark.parametrize("qn", sorted(TPCH_QUERIES))
+# q21 (4-way join + two correlated EXISTS probes) dominates the corpus
+# wall (~70s on the 1-core CI host) -> slow-swept; the other 21 stay tier-1
+@pytest.mark.parametrize(
+    "qn", [pytest.param(q, marks=pytest.mark.slow) if q == 21 else q
+           for q in sorted(TPCH_QUERIES)])
 def test_tpch_local_vs_oracle(local, oracle, qn):
     got = [norm_row(r) for r in local.execute(TPCH_QUERIES[qn]).rows]
     want = [list(r) for r in

@@ -41,13 +41,24 @@ class StringDictionary:
     merges produce a new dictionary plus a remap array usable as a device
     gather (reference analog: DictionaryBlock id remapping,
     spi/block/DictionaryBlock.java).
+
+    Equality/hash are CONTENT-based (order-sensitive, via a cached
+    fingerprint): the dictionary rides in the Column pytree aux, so
+    jax's trace-cache treedef comparison uses ``__eq__`` — and any
+    trace constant derived from a dictionary (merge remaps, per-entry
+    predicate masks) is a pure function of the ordered value list.
+    Content equality therefore means "same compiled program", which is
+    what lets an AOT-fabricated dictionary (exec/aot.py, rebuilt from
+    a hot-shape payload) land the live query on a compiled-program HIT
+    instead of an identity-mismatch retrace.
     """
 
-    __slots__ = ("values", "_index")
+    __slots__ = ("values", "_index", "_fp")
 
     def __init__(self, values: np.ndarray, _index: Optional[dict] = None):
         self.values = np.asarray(values, dtype=object)
         self._index = _index
+        self._fp: Optional[tuple] = None
 
     @staticmethod
     def from_strings(strings: Sequence[Optional[str]]):
@@ -88,6 +99,39 @@ class StringDictionary:
         ranks = np.empty(len(self.values), dtype=np.int32)
         ranks[order] = np.arange(len(self.values), dtype=np.int32)
         return ranks
+
+    @property
+    def fingerprint(self) -> tuple:
+        """(length, blake2b-128 of the ordered value list) — computed
+        once and cached. Order-sensitive on purpose: codes index
+        ``values``, so two pools with the same set but different order
+        are NOT interchangeable."""
+        if self._fp is None:
+            import hashlib
+            h = hashlib.blake2b(digest_size=16)
+            for v in self.values:
+                if v is None:
+                    h.update(b"\xff\x00\x00\x00\x00")
+                else:
+                    b = str(v).encode("utf-8", "surrogatepass")
+                    h.update(len(b).to_bytes(4, "little"))
+                    h.update(b)
+            self._fp = (len(self.values), h.digest())
+        return self._fp
+
+    def __eq__(self, other):
+        if other is self:
+            return True
+        if not isinstance(other, StringDictionary):
+            return NotImplemented
+        return self.fingerprint == other.fingerprint
+
+    def __ne__(self, other):
+        eq = self.__eq__(other)
+        return eq if eq is NotImplemented else not eq
+
+    def __hash__(self) -> int:
+        return hash(self.fingerprint)
 
     def merge(self, other: "StringDictionary"):
         """Unify with other; returns (merged, remap_self, remap_other)."""

@@ -79,15 +79,84 @@ def _aval_batch(payload: dict, schema):
     return Batch(cols, num_rows)
 
 
-def compile_entry(entry: dict) -> Optional[float]:
-    """AOT-compile one hot-shape registry entry. Returns the compile
-    wall in seconds, or None when the program was already resident in
-    the in-process cache (a hit — nothing to do). Raises on a broken
-    payload; callers treat per-entry failures as skippable."""
-    import jax
-    from . import executor as ex
+def _peeled_fragment(payload: dict):
+    """(top-down canonical nodes, fps key, input schema) from a
+    fragment-carrying payload — the chain/stream/window transport."""
     from .progkey import node_fingerprint, peel_wire_fragment
     from ..plan.serde import from_jsonable
+    root = from_jsonable(payload["fragment"])
+    nodes, schema = peel_wire_fragment(root)
+    fps = tuple(node_fingerprint(n) for n in nodes)
+    if any(f is None for f in fps):
+        raise ValueError("hot-shape fragment is not canonicalizable")
+    return nodes, fps, schema
+
+
+def _mjoin_programs(payload: dict) -> list:
+    """The TWO programs of one materialized hash join (count + expand,
+    exec/executor.py) from their shared payload — a join pre-warm is
+    incomplete unless both phases land in the cache."""
+    import jax
+    from . import executor as ex
+    from ..plan.serde import from_jsonable
+    from .streamjoin import _spec_from_payload
+    frag = from_jsonable(payload["fragment"])
+    pschema, bschema = dict(frag.left.schema), dict(frag.right.schema)
+    pkeys = [c.left for c in frag.criteria]
+    bkeys = [c.right for c in frag.criteria]
+    pcap = int(payload["chunk_capacity"])
+    bcap = int(payload["build_capacity"])
+    out_cap = int(payload["out_capacity"])
+    pspec = _spec_from_payload(payload["probe_cols"])
+    bspec = _spec_from_payload(payload["build_cols"])
+    outer = frag.join_type == "left"
+    probe = _aval_batch(
+        {"cols": payload["probe_cols"], "capacity": pcap,
+         "num_rows": payload.get("probe_num_rows", "int")}, pschema)
+    build = _aval_batch(
+        {"cols": payload["build_cols"], "capacity": bcap,
+         "num_rows": payload.get("build_num_rows", "int")}, bschema)
+
+    def i64(n: int):
+        return jax.ShapeDtypeStruct((n,), np.dtype(np.int64))
+
+    ckey = ex.mjoin_count_key(outer, pkeys, bkeys, pspec, bspec,
+                              pcap, bcap)
+    ekey = ex.mjoin_expand_key(frag.join_type, repr(frag.filter),
+                               pspec, bspec, pcap, bcap, out_cap)
+    return [
+        (ckey, ex.make_mjoin_count_program(pkeys, bkeys, outer),
+         (probe, build), ex._MJOIN_JIT_CACHE),
+        (ekey, ex.make_mjoin_expand_program(frag.join_type,
+                                            frag.filter, out_cap),
+         (probe, build, i64(pcap), i64(pcap), i64(bcap)),
+         ex._MJOIN_JIT_CACHE)]
+
+
+def _repartition_program(payload: dict) -> tuple:
+    import jax
+    from ..stage import repartition as rp
+    nkeys = int(payload["nkeys"])
+    cap = int(payload["capacity"])
+    nparts = int(payload["nparts"])
+    lanes = tuple(jax.ShapeDtypeStruct((cap,), np.dtype(np.uint64))
+                  for _ in range(nkeys))
+    valids = tuple(jax.ShapeDtypeStruct((cap,), np.dtype(bool))
+                   for _ in range(nkeys))
+    return (rp.bucket_program_key(nkeys, cap, nparts),
+            rp.make_bucket_program(nkeys, nparts), (lanes, valids),
+            rp._BUCKET_JIT_CACHE)
+
+
+def compile_entry(entry: dict) -> Optional[float]:
+    """AOT-compile one hot-shape registry entry — every jitted program
+    the entry's shape needs (a materialized join carries two: count +
+    expand). Returns the total compile wall in seconds, or None when
+    all programs were already resident in their in-process caches (a
+    hit — nothing to do). Raises on a broken payload; callers treat
+    per-entry failures as skippable."""
+    import jax
+    from . import executor as ex
 
     payload = entry["payload"] if "payload" in entry else entry
     kind = str(payload["kind"])
@@ -99,14 +168,26 @@ def compile_entry(entry: dict) -> Optional[float]:
         # canonical chunk capacity too
         from .streamjoin import _JOIN_JIT_CACHE, aot_entry
         key, fn, args = aot_entry(payload)
-        cache = _JOIN_JIT_CACHE
+        programs = [(key, fn, args, _JOIN_JIT_CACHE)]
+    elif kind == "join":
+        # materialized hash join: same wire form as streamjoin, two
+        # programs (exec/executor.py mjoin count/expand)
+        programs = _mjoin_programs(payload)
+    elif kind == "repartition":
+        # the exchange bucketing kernel (stage/repartition.py) — no
+        # fragment, just the (key count, capacity, nparts) signature
+        programs = [_repartition_program(payload)]
+    elif kind == "window":
+        from .window import execute_window
+        nodes, fps, schema = _peeled_fragment(payload)
+        wnode = nodes[0]
+
+        def wfn(b):
+            return execute_window(b, wnode)
+        programs = [(fps, wfn, (_aval_batch(payload, schema),),
+                     ex._WINDOW_JIT_CACHE)]
     else:
-        root = from_jsonable(payload["fragment"])
-        nodes, schema = peel_wire_fragment(root)
-        fps = tuple(node_fingerprint(n) for n in nodes)
-        if any(f is None for f in fps):
-            raise ValueError("hot-shape fragment is not "
-                             "canonicalizable")
+        nodes, fps, schema = _peeled_fragment(payload)
 
         # the same helper shape the executor's structural closures
         # capture: detached (no per-query state), catalogs untouched
@@ -132,26 +213,31 @@ def compile_entry(entry: dict) -> Optional[float]:
             fn = run if kind == "stream" else run_full
         else:
             raise ValueError(f"unknown hot-shape kind {kind!r}")
-        args = (_aval_batch(payload, schema),)
+        programs = [(key, fn, (_aval_batch(payload, schema),), cache)]
 
-    with ex._JIT_CACHE_LOCK:
-        resident = key in cache
-    if resident:
+    wall = 0.0
+    compiled = False
+    for key, fn, args, cache in programs:
+        with ex._JIT_CACHE_LOCK:
+            resident = key in cache
+        if resident:
+            continue
+        t0 = time.perf_counter()
+        try:
+            jitted = jax.jit(fn)
+            jitted.lower(*args).compile()
+        except Exception:
+            _M_AOT.inc(kind=kind, result="error")
+            raise
+        wall += time.perf_counter() - t0
+        # the jitted callable (now holding the compiled program in its
+        # own cache) lands under the executor's key: the first real
+        # query with this shape is an in-process cache hit
+        ex._cache_put(cache, key, jitted)
+        compiled = True
+    if not compiled:
         _M_AOT.inc(kind=kind, result="cached")
         return None
-
-    t0 = time.perf_counter()
-    try:
-        jitted = jax.jit(fn)
-        jitted.lower(*args).compile()
-    except Exception:
-        _M_AOT.inc(kind=kind, result="error")
-        raise
-    wall = time.perf_counter() - t0
-    # the jitted callable (now holding the compiled program in its own
-    # cache) lands under the executor's key: the first real query with
-    # this shape is an in-process cache hit
-    ex._cache_put(cache, key, jitted)
     _M_AOT.inc(kind=kind, result="compiled")
     _M_AOT_WALL.observe(wall, kind=kind)
     return wall

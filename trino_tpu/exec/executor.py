@@ -250,6 +250,13 @@ _STREAM_JIT_CACHE: Dict[tuple, object] = {}
 _STREAM_JIT_DENY: set = set()
 _CHAIN_JIT_CACHE: Dict[tuple, object] = {}
 _CHAIN_JIT_DENY: set = set()
+# window programs (execute_window over one canonical WindowNode) and
+# the two-phase materialized hash-join programs (count + expand over
+# ops/join.py) — the "window" and "join" AOT kinds of exec/aot.py
+_WINDOW_JIT_CACHE: Dict[tuple, object] = {}
+_WINDOW_JIT_DENY: set = set()
+_MJOIN_JIT_CACHE: Dict[tuple, object] = {}
+_MJOIN_JIT_DENY: set = set()
 
 # process metrics (obs/metrics.py; scraped at GET /metrics). These are
 # per-query-phase increments, never per-row — the lock cost is noise.
@@ -1302,6 +1309,102 @@ class Executor:
     # ------------------------------------------------------------------
     # joins
     # ------------------------------------------------------------------
+    def _mjoin_program(self, key: tuple, builder):
+        """Lookup-or-build one jitted materialized-join program in the
+        cross-query cache. None when the key is denied (a prior trace
+        hit host-only evaluation); the caller falls back to the eager
+        two-phase path."""
+        if key in _MJOIN_JIT_DENY:
+            return None
+        jitted = _MJOIN_JIT_CACHE.get(key)
+        hit = jitted is not None
+        _M_JIT.inc(cache="join", result="hit" if hit else "miss")
+        if jitted is None:
+            jitted = jax.jit(builder())
+            _cache_put(_MJOIN_JIT_CACHE, key, jitted)
+        return jitted, hit
+
+    @staticmethod
+    def _mjoin_jittable(probe: Batch, build: Batch) -> bool:
+        # nested ARRAY/MAP/ROW lanes keep the eager path (their AOT
+        # payload cannot be rebuilt, and the win is in the flat TPC-H
+        # lanes anyway)
+        return not any(
+            c.elements is not None or c.children is not None
+            for c in list(probe.columns.values())
+            + list(build.columns.values()))
+
+    def _mjoin_counts(self, probe: Batch, build: Batch, pkeys, bkeys,
+                      outer: bool):
+        """Jitted count phase of the materialized join. Returns
+        (start, count, order, total) device arrays, or None on decline
+        — the caller runs ops/join.py eagerly."""
+        if not (self.fragment_jit
+                and self._mjoin_jittable(probe, build)):
+            return None
+        from .streamjoin import _lane_spec
+        key = mjoin_count_key(outer, pkeys, bkeys, _lane_spec(probe),
+                              _lane_spec(build), probe.capacity,
+                              build.capacity)
+        got = self._mjoin_program(
+            key, lambda: make_mjoin_count_program(pkeys, bkeys, outer))
+        if got is None:
+            return None
+        jitted, hit = got
+        try:
+            return self._jit_call(jitted, (probe, build), "join", hit)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            _MJOIN_JIT_CACHE.pop(key, None)
+            _MJOIN_JIT_DENY.add(key)
+            return None
+
+    def _mjoin_expand(self, probe: Batch, build: Batch, start, count,
+                      order, jt: str, residual, out_cap: int,
+                      criteria=None) -> Optional[Batch]:
+        """Jitted expand phase (+ fused residual filter). On first
+        success the join's full two-program shape is recorded into the
+        hot-shape registry (exec/hotshapes.py) so exec/aot.py can
+        pre-compile BOTH phases into these same cache slots."""
+        if not (self.fragment_jit
+                and self._mjoin_jittable(probe, build)):
+            return None
+        from .streamjoin import _join_payload, _lane_spec
+        key = mjoin_expand_key(jt, repr(residual), _lane_spec(probe),
+                               _lane_spec(build), probe.capacity,
+                               build.capacity, out_cap)
+        got = self._mjoin_program(
+            key, lambda: make_mjoin_expand_program(jt, residual,
+                                                   out_cap))
+        if got is None:
+            return None
+        jitted, hit = got
+        args = (probe, build, jnp.asarray(start, jnp.int64),
+                jnp.asarray(count, jnp.int64),
+                jnp.asarray(order, jnp.int64))
+        try:
+            out = self._jit_call(jitted, args, "join", hit)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            _MJOIN_JIT_CACHE.pop(key, None)
+            _MJOIN_JIT_DENY.add(key)
+            return None
+        if criteria is not None:
+            from .hotshapes import record_program
+
+            def build_pl():
+                return _join_payload(jt, criteria, residual, probe,
+                                     build, out_cap, kind="join")
+            # the registry key carries the join keys too: two joins
+            # sharing lane specs share the expand program but each
+            # needs its own count program compiled
+            record_program(
+                "join",
+                ("mjoin", tuple(c.left for c in criteria),
+                 tuple(c.right for c in criteria), key),
+                None, None, self.session, payload_fn=build_pl)
+        return out
+
     def _exec_JoinNode(self, node: JoinNode) -> Batch:
         jt = node.join_type
         if jt == "right":
@@ -1336,24 +1439,42 @@ class Executor:
         filt = join_verify_filter(left.columns, right.columns,
                                   pkeys, bkeys, node.filter)
         if filt is None:
-            start, count, order = join_ops.match_counts(
-                left, right, pkeys, bkeys)
             outer = jt in ("left", "full")
-            live_p = left.row_valid()
-            eff = jnp.where(live_p, jnp.maximum(count, 1), 0) if outer \
-                else count
-            total = int(jnp.sum(eff))
+            counted = self._mjoin_counts(left, right, pkeys, bkeys,
+                                         outer)
+            if counted is not None:
+                start, count, order, total_dev = counted
+                eff = None      # only the oversized path needs it
+                total = int(total_dev)
+            else:
+                start, count, order = join_ops.match_counts(
+                    left, right, pkeys, bkeys)
+                live_p = left.row_valid()
+                eff = jnp.where(live_p, jnp.maximum(count, 1), 0) \
+                    if outer else count
+                total = int(jnp.sum(eff))
             width = len(left.columns) + len(right.columns)
             if total > CONFIG.max_batch_rows:
+                if eff is None:
+                    eff = jnp.where(left.row_valid(),
+                                    jnp.maximum(count, 1), 0) \
+                        if outer else count
                 out = self._oversized_join(
                     left, right, start, count, eff, order, total,
                     width, "left" if outer else "inner")
             else:
                 self._reserve(total, width, "join output")
                 cap = capacity_for(total)
-                out = join_ops.expand_join(
-                    left, right, start, count, order, cap,
-                    "left" if outer else "inner")
+                out = None
+                if counted is not None:
+                    out = self._mjoin_expand(
+                        left, right, start, count, order,
+                        "left" if outer else "inner", None, cap,
+                        criteria=node.criteria)
+                if out is None:
+                    out = join_ops.expand_join(
+                        left, right, start, count, order, cap,
+                        "left" if outer else "inner")
             if jt == "full":
                 out = self._append_right_unmatched(
                     out, left, right, pkeys, bkeys)
@@ -1365,9 +1486,14 @@ class Executor:
         probe = self._with_pos(left, _PPOS) if jt in ("left", "full") \
             else left
         build = self._with_pos(right, _BPOS) if jt == "full" else right
-        start, count, order = join_ops.match_counts(
-            probe, build, pkeys, bkeys)
-        total = int(jnp.sum(count))
+        counted = self._mjoin_counts(probe, build, pkeys, bkeys, False)
+        if counted is not None:
+            start, count, order, total_dev = counted
+            total = int(total_dev)
+        else:
+            start, count, order = join_ops.match_counts(
+                probe, build, pkeys, bkeys)
+            total = int(jnp.sum(count))
         width = len(probe.columns) + len(build.columns)
         if total > CONFIG.max_batch_rows and jt == "inner":
             out = self._oversized_join(probe, build, start, count, count,
@@ -1376,10 +1502,16 @@ class Executor:
             return self._repair_outer(out, left, right, jt)
         self._reserve(total, width, "join candidates")
         cap = capacity_for(total)
-        cand = join_ops.expand_join(probe, build, start, count, order,
-                                    cap, "inner")
-        mask = eval_predicate(filt, cand)
-        out = compact.filter_batch(cand, mask)
+        out = None
+        if counted is not None:
+            out = self._mjoin_expand(probe, build, start, count, order,
+                                     "inner", filt, cap,
+                                     criteria=node.criteria)
+        if out is None:
+            cand = join_ops.expand_join(probe, build, start, count,
+                                        order, cap, "inner")
+            mask = eval_predicate(filt, cand)
+            out = compact.filter_batch(cand, mask)
         return self._repair_outer(out, left, right, jt)
 
     def _reserve(self, rows: int, n_lanes: int, what: str) -> None:
@@ -1659,9 +1791,39 @@ class Executor:
     # windows
     # ------------------------------------------------------------------
     def _exec_WindowNode(self, node: WindowNode) -> Batch:
-        from .window import execute_window
+        from .window import execute_window, window_traceable
         src = self.execute(node.source)
-        return execute_window(src, node)
+        if not (self.fragment_jit and window_traceable(node)):
+            return execute_window(src, node)
+        from .progkey import canonicalize_nodes
+        canon = canonicalize_nodes([node])
+        if canon is None or canon.key in _WINDOW_JIT_DENY:
+            return execute_window(src, node)
+        key = canon.key
+        jitted = _WINDOW_JIT_CACHE.get(key)
+        hit = jitted is not None
+        _M_JIT.inc(cache="window", result="hit" if hit else "miss")
+        if jitted is None:
+            wnode = canon.nodes[0]
+
+            def fn(b: Batch) -> Batch:
+                return execute_window(b, wnode)
+            jitted = jax.jit(fn)
+            _cache_put(_WINDOW_JIT_CACHE, key, jitted)
+        binding = canon.binding(src)
+        cb = binding.rename_in(src)
+        from .hotshapes import record_program
+        record_program("window", key, canon, cb, self.session)
+        try:
+            out = self._jit_call(jitted, (cb,), "window", hit)
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.ConcretizationTypeError):
+            # a lane/function combination that materializes on host
+            # despite the traceability gate: run eagerly ever after
+            _WINDOW_JIT_CACHE.pop(key, None)
+            _WINDOW_JIT_DENY.add(key)
+            return execute_window(src, node)
+        return binding.rename_out(out)
 
     # ------------------------------------------------------------------
     def _exec_ExchangeNode(self, node: ExchangeNode) -> Batch:
@@ -1757,6 +1919,72 @@ def make_stream_runners(helper: "Executor", chain, node):
         return out
 
     return run, run_full
+
+
+# --------------------------------------------------------------------------
+# materialized hash-join programs (the "join" AOT kind)
+# --------------------------------------------------------------------------
+# The eager join in _exec_JoinNode is already two-phase ("count, pick
+# bucket, expand" — ops/join.py): the count phase is the only host
+# sync, the expansion runs at a static capacity bucket. Each phase is
+# therefore one traceable program; jitting them separately keeps the
+# host-side total/bucket decision OUT of the traced code while every
+# device op (lane hashing, searchsorted, gather expansion, residual
+# filtering) fuses. Builders are module-level so exec/aot.py rebuilds
+# the EXACT closures the executor caches (progkey doctrine: one key
+# per program, shared by the live path and the pre-warmer).
+
+def mjoin_count_key(outer: bool, pkeys, bkeys, probe_spec, build_spec,
+                    probe_cap: int, build_cap: int) -> tuple:
+    return ("mjoin_count", bool(outer), tuple(pkeys), tuple(bkeys),
+            probe_spec, build_spec, int(probe_cap), int(build_cap))
+
+
+def mjoin_expand_key(jt: str, residual_repr: str, probe_spec,
+                     build_spec, probe_cap: int, build_cap: int,
+                     out_cap: int) -> tuple:
+    return ("mjoin_expand", jt, residual_repr, probe_spec, build_spec,
+            int(probe_cap), int(build_cap), int(out_cap))
+
+
+def make_mjoin_count_program(pkeys, bkeys, outer: bool):
+    """Phase 1: build-side sort + probe match counts + the effective
+    output total. Everything downstream of the total is host policy
+    (bucket choice, memory reserve, oversized spill), so the program
+    ends exactly at the host-sync boundary. Output dtypes are pinned
+    int64 — they cross into the separately-jitted expand program."""
+    pkeys, bkeys = list(pkeys), list(bkeys)
+
+    def fn(probe: Batch, build: Batch):
+        start, count, order = join_ops.match_counts(
+            probe, build, pkeys, bkeys)
+        if outer:
+            eff = jnp.where(probe.row_valid(),
+                            jnp.maximum(count, 1), 0)
+        else:
+            eff = count
+        return (start.astype(jnp.int64), count.astype(jnp.int64),
+                order.astype(jnp.int64), jnp.sum(eff))
+
+    return fn
+
+
+def make_mjoin_expand_program(jt: str, residual, out_cap: int):
+    """Phase 2: gather-expand the match set at the chosen capacity
+    bucket; with a residual, the candidate expansion, predicate and
+    compaction fuse into the same program (the streamed-join probe
+    kernel's shape, minus the chunk loop)."""
+
+    def fn(probe: Batch, build: Batch, start, count, order):
+        out = join_ops.expand_join(probe, build, start, count, order,
+                                   out_cap, "inner" if residual is not None
+                                   else jt)
+        if residual is None:
+            return out
+        mask = eval_predicate(residual, out)
+        return compact.filter_batch(out, mask)
+
+    return fn
 
 
 def setop_tag(lb: Batch, rb: Batch):

@@ -21,6 +21,14 @@ task status payloads (``hotShapes``), so the coordinator's registry
 covers every DISPATCHED fragment's shapes, not only what its own
 combine stage compiled.
 
+Recorded kinds span the FULL warm path (exec/aot.py dispatches on
+``payload["kind"]``): ``chain`` / ``stream`` / ``stream_full``
+(canonical fragment programs), ``streamjoin`` (the streamed-probe
+chunk kernel), ``join`` (the materialized hash join's count + expand
+program pair), ``window`` (execute_window over one canonical
+WindowNode), and ``repartition`` (the exchange bucketing kernel —
+signature-only, no fragment).
+
 Shared-runtime code: the registry is mutated by query executor
 threads, task threads, and HTTP handler threads concurrently — every
 method takes the registry lock (and the module is on the race-lint

@@ -53,7 +53,7 @@ from ..plan.nodes import (Aggregate, AggregationNode, AssignUniqueIdNode,
                           FilterNode, LimitNode, MarkDistinctNode,
                           OffsetNode, PlanNode, ProjectNode,
                           RemoteSourceNode, SampleNode, SortKey,
-                          SortNode, TopNNode)
+                          SortNode, TopNNode, WindowFunction, WindowNode)
 from ..rex import (VOLATILE_FNS, Call, CaseExpr, Cast, Const, InputRef,
                    Lambda, RowExpr)
 
@@ -152,7 +152,27 @@ def _canon_node(nd: PlanNode, m: _SymbolMap) -> PlanNode:
             group_keys=tuple(m.sym(k) for k in nd.group_keys),
             aggregates={m.sym(out): _canon_aggregate(a, m)
                         for out, a in nd.aggregates.items()})
+    if isinstance(nd, WindowNode):
+        # inputs before outputs (same discipline as ProjectNode):
+        # partition/order keys and per-function argument symbols map
+        # first, then the function output symbols
+        part = tuple(m.sym(s) for s in nd.partition_by)
+        order = tuple(SortKey(m.sym(k.symbol), k.ascending,
+                              k.nulls_first) for k in nd.order_by)
+        fns = {out: _canon_window_fn(f, m)
+               for out, f in nd.functions.items()}
+        return dc_replace(nd, partition_by=part, order_by=order,
+                          functions={m.sym(out): f
+                                     for out, f in fns.items()})
     raise _NotCanonical(type(nd).__name__)
+
+
+def _canon_window_fn(f: WindowFunction, m: _SymbolMap) -> WindowFunction:
+    return dc_replace(
+        f,
+        argument=None if f.argument is None else m.sym(f.argument),
+        offset=None if f.offset is None else m.sym(f.offset),
+        default=None if f.default is None else m.sym(f.default))
 
 
 def node_fingerprint(nd: PlanNode) -> Optional[tuple]:
@@ -191,6 +211,13 @@ def node_fingerprint(nd: PlanNode) -> Optional[tuple]:
                 tuple((out, a.kind, a.argument, a.argument2, a.mask,
                        a.distinct, a.param, repr(a.type))
                       for out, a in nd.aggregates.items()))
+    if isinstance(nd, WindowNode):
+        return ("W", tuple(nd.partition_by), nd.order_by,
+                tuple((out, f.kind, f.argument, repr(f.type),
+                       f.frame_unit, f.frame_start, f.frame_end,
+                       f.offset, f.default, f.frame_start_value,
+                       f.frame_end_value)
+                      for out, f in nd.functions.items()))
     return None
 
 

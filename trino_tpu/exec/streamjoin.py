@@ -43,10 +43,11 @@ full-materialization estimate — the PR 10 cluster pool sees what the
 operator actually holds, so the low-memory killer stops shooting
 queries streaming can serve.
 
-Limits (fall back to the materialized path): FULL joins, dictionary
-(string) columns on the streamed probe side (a per-chunk dictionary
-identity would re-trace every chunk), nested (ARRAY/MAP/ROW) scan
-columns, and semi joins.
+Limits (fall back to the materialized path): FULL joins, string
+columns CREATED by the probe chain (a chain-minted dictionary per
+chunk would re-trace every chunk; strings read off the scan stream
+through the per-stream canonical layout of ``_StreamDictEncoder``),
+nested (ARRAY/MAP/ROW) scan columns, and semi joins.
 
 Shared-runtime code: the jitted-program caches here are mutated by
 query executor threads and the worker pre-warm thread concurrently —
@@ -63,7 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..columnar import Batch, Column, empty_batch
+from ..columnar import Batch, Column, StringDictionary, empty_batch
 from ..config import CONFIG, capacity_for
 from ..obs.metrics import (JIT_CACHE_LOOKUPS as _M_JIT, METRICS,
                            STREAM_CHUNKS, STREAM_H2D_BYTES,
@@ -669,13 +670,17 @@ def make_probe_program(jt: str, pkeys: Sequence[str],
 
 
 def _join_payload(jt, criteria, residual, chunk: Batch, build: Batch,
-                  out_cap: int) -> Optional[dict]:
-    """AOT transport form of one streamed-join probe program: the join
-    shape as a wire fragment (JoinNode over two schema-carrying
-    RemoteSource leaves, ``filter`` holding the FULL residual incl.
-    hash-verify conjuncts) + both sides' lane specs at their
-    capacities. None when a side carries lanes the AOT rebuilder
-    cannot fabricate (nested columns, large dictionaries)."""
+                  out_cap: int, kind: str = "streamjoin"
+                  ) -> Optional[dict]:
+    """AOT transport form of one hash-join program set: the join shape
+    as a wire fragment (JoinNode over two schema-carrying RemoteSource
+    leaves, ``filter`` holding the FULL residual incl. hash-verify
+    conjuncts) + both sides' lane specs at their capacities. Shared by
+    the streamed probe program (kind="streamjoin") and the
+    materialized two-phase programs (kind="join" — exec/executor.py);
+    for the latter ``chunk`` is the whole probe batch. None when a
+    side carries lanes the AOT rebuilder cannot fabricate (nested
+    columns, large dictionaries)."""
     from ..plan.serde import to_jsonable
     from .hotshapes import MAX_DICT_ENTRIES
 
@@ -712,7 +717,7 @@ def _join_payload(jt, criteria, residual, chunk: Batch, build: Batch,
         return ("int" if isinstance(b.num_rows, int)
                 else str(np.dtype(b.num_rows.dtype)))
 
-    return {"kind": "streamjoin",
+    return {"kind": kind,
             "fragment": to_jsonable(frag),
             "probe_cols": pcols, "build_cols": bcols,
             "chunk_capacity": int(chunk.capacity),
@@ -761,6 +766,67 @@ def aot_entry(payload: dict):
     return key, fn, (chunk, build, sorted_lane, order, m)
 
 
+class _StreamDictEncoder:
+    """Canonical per-stream code layout for probe-side string columns.
+
+    Every split/chunk read off the connector carries its own
+    StringDictionary — a STATIC aux of the Batch pytree, so a fresh
+    identity per chunk would re-trace the chain and probe programs on
+    every chunk. The encoder fixes ONE stream-level dictionary per
+    column (join-key columns are seeded with the BUILD side's
+    dictionary, so remapped probe codes compare directly against the
+    prebuilt sorted key lane — the per-chunk align_string_keys merge
+    of the materialized path, hoisted to stream setup) and host-remaps
+    each chunk's codes into that layout inside the double-buffer
+    window. Chunks introducing genuinely new values extend the layout
+    append-only: existing codes never move, ONE re-trace per extension
+    instead of one per chunk, and values absent from the build
+    dictionary get codes past its length — codes the sorted build
+    lane cannot contain, so they match nothing, exactly what string
+    equality requires."""
+
+    def __init__(self, seeds: Dict[str, StringDictionary]):
+        self._dicts: Dict[str, StringDictionary] = dict(seeds)
+
+    def encode(self, chunk: Batch) -> Batch:
+        cols = dict(chunk.columns)
+        changed = False
+        for name, c in chunk.columns.items():
+            if c.dictionary is None:
+                continue
+            d = self._dicts.get(name)
+            if d is None:
+                self._dicts[name] = c.dictionary
+                continue
+            if c.dictionary is d:
+                continue
+            idx = d.index
+            vals = c.dictionary.values
+            remap = np.empty(len(vals), dtype=np.int32)
+            fresh = []
+            for i, s in enumerate(vals):
+                code = idx.get(s)
+                if code is None:
+                    fresh.append((i, s))
+                else:
+                    remap[i] = code
+            if fresh:
+                ext = list(d.values)
+                nidx = dict(idx)
+                for i, s in fresh:
+                    remap[i] = len(ext)
+                    nidx[s] = len(ext)
+                    ext.append(s)
+                d = StringDictionary(np.asarray(ext, dtype=object),
+                                     nidx)
+                self._dicts[name] = d
+            codes = np.take(remap,
+                            np.asarray(c.data).astype(np.int32))
+            cols[name] = Column(c.type, codes, c.valid, d, c.data2)
+            changed = True
+        return Batch(cols, chunk.num_rows) if changed else chunk
+
+
 def maybe_stream_join(ex, node: JoinNode
                       ) -> Tuple[Optional[Batch], Optional[Batch]]:
     """Chunk-stream the probe side of a hash join whose probe scan
@@ -784,25 +850,26 @@ def maybe_stream_join(ex, node: JoinNode
     pschema = chain[0].output_schema() if chain \
         else scan.output_schema()
     bschema = node.right.output_schema()
-    # dictionary probe columns would give every chunk a fresh
-    # dictionary identity (a static aux of the Batch pytree) — a
-    # re-trace per chunk; nested columns cannot chunk-slice. Both
-    # decline to the materialized path. The BUILD side may carry
-    # dictionaries: it is materialized once, its identity is stable.
+    # nested columns cannot chunk-slice; string columns stream through
+    # the per-stream canonical dictionary layout (_StreamDictEncoder)
+    # — but only when read off the SCAN: a string column the chain
+    # creates would mint a fresh dictionary per chunk (a re-trace per
+    # chunk), so those decline to the materialized path. The BUILD
+    # side may carry dictionaries freely: it is materialized once,
+    # its identity is stable.
     from ..types import is_string
-    if not all(_col_streamable(t) and not is_string(t)
-               for t in pschema.values()):
+    if not all(_col_streamable(t) for t in pschema.values()):
         return None, None
-    if not all(_col_streamable(t) for t in scan.schema.values()) \
-            or any(is_string(t) for t in scan.schema.values()):
+    if not all(_col_streamable(t) for t in scan.schema.values()):
+        return None, None
+    if any(is_string(t) and s not in scan.schema
+           for s, t in pschema.items()):
         return None, None
     pkeys = [c.left for c in node.criteria]
     bkeys = [c.right for c in node.criteria]
     if any(k not in pschema for k in pkeys) \
             or any(k not in bschema for k in bkeys):
         return None, None
-    if any(is_string(bschema[k]) for k in bkeys):
-        return None, None       # string keys need per-chunk dict merge
     forced, budget, est = gate
     if forced <= 0 and (est is None or 4 * est <= budget):
         # heuristic pre-decline: the exact remaining-after-build rule
@@ -833,6 +900,13 @@ def maybe_stream_join(ex, node: JoinNode
     sorted_lane, order, m = join_ops.build_side(build, bkeys)
     order = order.astype(jnp.int64)
     m = m.astype(jnp.int64)
+    # probe-side canonical dictionaries: key columns seed from the
+    # BUILD dictionary so remapped probe codes compare directly
+    # against the sorted build key lane just computed
+    enc = _StreamDictEncoder(
+        {pk: build.column(bk).dictionary
+         for pk, bk in zip(pkeys, bkeys)
+         if build.column(bk).dictionary is not None})
 
     probe_row = _row_bytes(pschema) + _row_bytes(scan.schema)
     out_row = _row_bytes(pschema) + _row_bytes(bschema) + 8
@@ -952,7 +1026,9 @@ def maybe_stream_join(ex, node: JoinNode
             outs.append(_to_host(out, n))
             total_rows += n
 
-    run_streamed(ex, "join", host_scan_chunks(ex, scan, chunk_cap),
+    run_streamed(ex, "join",
+                 (enc.encode(c)
+                  for c in host_scan_chunks(ex, scan, chunk_cap)),
                  dispatch, collect)
     if not outs:
         # zero matches / empty probe: synthesize the joined schema

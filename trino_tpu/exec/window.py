@@ -311,6 +311,24 @@ def _eval_fn(fn: WindowFunction, src: Batch, order, live_s, pid, pos,
     raise ValueError(f"window function '{k}' not implemented")
 
 
+#: function kinds that return before the explicit-frame dispatch in
+#: ``_eval_fn`` — an explicit frame on these never reaches the host
+#: ``_framed_eval`` path, so they stay jit-traceable regardless.
+_PRE_FRAME_KINDS = frozenset((
+    "row_number", "rank", "dense_rank", "percent_rank", "cume_dist",
+    "ntile", "lag", "lead"))
+
+
+def window_traceable(node: WindowNode) -> bool:
+    """True when ``execute_window(src, node)`` is a pure jnp program
+    for this node — the gate for the structural window jit cache
+    (exec/executor.py). Explicit-frame aggregates evaluate through
+    ``_framed_eval``, which is host numpy (sparse-table RMQ, per-
+    partition searchsorted loops) and cannot trace."""
+    return not any(_explicit_frame(f) and f.kind not in _PRE_FRAME_KINDS
+                   for f in node.functions.values())
+
+
 def _explicit_frame(fn) -> bool:
     """True when the function carries a frame the default running/
     whole-partition paths can't express: offset bounds, GROUPS unit, or

@@ -349,7 +349,8 @@ class _Task:
                     keys, kind = plan.partition_keys, plan.kind
                 self.pages = partition_frames(  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                     res, keys, kind,
-                    int(stage.get("nparts_out") or 1), codec=codec)
+                    int(stage.get("nparts_out") or 1), codec=codec,
+                    session=session)
                 self.spool.commit(str(stage["exchange_key"]), 0, 0,
                                   self.attempt, self.pages)
             else:

@@ -27,15 +27,15 @@ upstream task — content-addressed, no manifest needed.
 
 from __future__ import annotations
 
-from functools import partial
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..columnar import Batch, Column
-from ..obs.metrics import (EXCHANGE_PARTITION_BYTES, EXCHANGE_PARTITIONS)
+from ..obs.metrics import (EXCHANGE_PARTITION_BYTES, EXCHANGE_PARTITIONS,
+                           JIT_CACHE_LOOKUPS)
 from ..ops.hashing import lane_to_u64, mix64, partition_of
 
 _FNV_OFFSET = 0xCBF29CE484222325
@@ -61,24 +61,39 @@ def dictionary_value_hashes(dictionary) -> np.ndarray:
     return out
 
 
-@partial(jax.jit, static_argnames=("nparts",))
-def _bucket_kernel(lanes: Tuple[jax.Array, ...],
-                   valids: Tuple[jax.Array, ...],
-                   nparts: int) -> jax.Array:
+# cross-query cache of jitted bucket kernels (exec/progkey.py cache
+# doctrine). Key lanes are ALWAYS uint64 and valids always bool, so
+# (key count, capacity, partition count) is the whole jit signature —
+# the one structural cache in the engine that needs no lane-spec walk.
+_BUCKET_JIT_CACHE: Dict[tuple, object] = {}
+
+
+def bucket_program_key(nkeys: int, capacity: int, nparts: int) -> tuple:
+    return ("repartition", int(nkeys), int(capacity), int(nparts))
+
+
+def make_bucket_program(nkeys: int, nparts: int):
     """Per-row partition bucket from pre-extracted uint64 key lanes:
     mix64 each lane (NULL rows -> 0), multiply-combine across key
     columns (CombineHashFunction's 31*h+x), mod the partition count.
-    One fused device program per (key count, shape)."""
-    hashed = [jnp.where(v, mix64(l), jnp.uint64(0))
-              for l, v in zip(lanes, valids)]
-    if len(hashed) == 1:
-        h = hashed[0]
-    else:
-        acc = jnp.zeros_like(hashed[0]) + jnp.uint64(0x9E3779B97F4A7C15)
-        for h1 in hashed:
-            acc = acc * jnp.uint64(31) + h1
-        h = mix64(acc)
-    return partition_of(h, nparts)
+    One fused device program per (key count, shape). Module-level
+    builder so exec/aot.py rebuilds the EXACT closure this cache
+    holds (the "repartition" AOT kind)."""
+
+    def fn(lanes, valids) -> jax.Array:
+        hashed = [jnp.where(v, mix64(l), jnp.uint64(0))
+                  for l, v in zip(lanes, valids)]
+        if nkeys == 1:
+            h = hashed[0]
+        else:
+            acc = jnp.zeros_like(hashed[0]) \
+                + jnp.uint64(0x9E3779B97F4A7C15)
+            for h1 in hashed:
+                acc = acc * jnp.uint64(31) + h1
+            h = mix64(acc)
+        return partition_of(h, nparts)
+
+    return fn
 
 
 def _key_lane(col: Column) -> jax.Array:
@@ -93,8 +108,10 @@ def _key_lane(col: Column) -> jax.Array:
 
 
 def partition_buckets(batch: Batch, keys: Sequence[str],
-                      nparts: int) -> np.ndarray:
+                      nparts: int, session=None) -> np.ndarray:
     """Bucket index in [0, nparts) for each LIVE row of ``batch``."""
+    from ..exec import executor as _ex
+    from ..exec.hotshapes import record_program
     n = batch.num_rows_host()
     lanes, valids = [], []
     for k in keys:
@@ -102,7 +119,21 @@ def partition_buckets(batch: Batch, keys: Sequence[str],
         lanes.append(_key_lane(c))
         valids.append(jnp.ones((c.capacity,), bool) if c.valid is None
                       else jnp.asarray(c.valid).astype(bool))
-    bk = _bucket_kernel(tuple(lanes), tuple(valids), nparts)
+    cap = int(batch.capacity)
+    key = bucket_program_key(len(keys), cap, nparts)
+    jitted = _BUCKET_JIT_CACHE.get(key)
+    hit = jitted is not None
+    JIT_CACHE_LOOKUPS.inc(cache="repartition",
+                          result="hit" if hit else "miss")
+    if jitted is None:
+        jitted = jax.jit(make_bucket_program(len(keys), nparts))
+        _ex._cache_put(_BUCKET_JIT_CACHE, key, jitted)
+    record_program(
+        "repartition", key, None, None, session,
+        payload_fn=lambda: {"kind": "repartition",
+                            "nkeys": len(keys), "capacity": cap,
+                            "nparts": int(nparts)})
+    bk = jitted(tuple(lanes), tuple(valids))
     return np.asarray(bk)[:n]
 
 
@@ -139,7 +170,7 @@ def _take_rows(batch: Batch, idx: np.ndarray, n: int) -> Batch:
 
 
 def partition_batch(batch: Batch, keys: Sequence[str],
-                    nparts: int) -> List[Batch]:
+                    nparts: int, session=None) -> List[Batch]:
     """Split ``batch`` into exactly ``nparts`` batches by key hash.
     Partitions are complete and disjoint: every live row lands in
     exactly one output, at bucket(partition_buckets). Empty partitions
@@ -151,7 +182,7 @@ def partition_batch(batch: Batch, keys: Sequence[str],
         # FIXED_ARBITRARY distributions)
         bk = np.arange(n, dtype=np.int64) % max(nparts, 1)
     else:
-        bk = partition_buckets(batch, keys, nparts)
+        bk = partition_buckets(batch, keys, nparts, session=session)
     host = Batch({s: _host_col(c) for s, c in batch.columns.items()},
                  n)
     return [_take_rows(host, np.flatnonzero(bk == p), n)
@@ -159,8 +190,8 @@ def partition_batch(batch: Batch, keys: Sequence[str],
 
 
 def partition_frames(batch: Batch, keys: Sequence[str], kind: str,
-                     nparts: int, codec: Optional[int] = None
-                     ) -> List[bytes]:
+                     nparts: int, codec: Optional[int] = None,
+                     session=None) -> List[bytes]:
     """Serialize a stage's output as partition frames: frame i IS
     partition i (one frame per partition — the deterministic layout the
     exchange contract requires; a consumer reads frame index
@@ -177,7 +208,7 @@ def partition_frames(batch: Batch, keys: Sequence[str], kind: str,
                       for s, c in batch.columns.items()}, n)
         parts = [_take_rows(host, np.arange(n, dtype=np.int64), n)]
     else:
-        parts = partition_batch(batch, keys, nparts)
+        parts = partition_batch(batch, keys, nparts, session=session)
     frames = [serialize_batch(p, codec=codec) for p in parts]
     EXCHANGE_PARTITIONS.inc(len(frames), direction="written")
     EXCHANGE_PARTITION_BYTES.inc(sum(len(f) for f in frames),
