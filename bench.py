@@ -393,6 +393,89 @@ def _leg_telemetry(schema: str, iters: int) -> dict:
                 **_cw_keys(off_cold, off))
 
 
+def _fault_failover_subleg() -> dict:
+    """Coordinator-failover resume mini-leg: a 3-stage distributed
+    query whose coordinator dies at the ``coordinator.post_stage_commit``
+    fault site (fte/faultpoints.py) right after the first stage's
+    partitions commit; a replacement coordinator binds the SAME port,
+    reloads the spooled execution manifest, re-reads the committed
+    partitions off the spool and re-dispatches only the rest. Reports
+    the wall seconds from coordinator death to the client seeing
+    FINISHED (through the ordinary nextUri chain — the client's
+    bounded poll retry rides out the outage) plus the resumed/replayed
+    partition split."""
+    import threading
+    import time as _time
+
+    from trino_tpu.client import StatementClient
+    from trino_tpu.fte import faultpoints
+    from trino_tpu.obs.metrics import FAILOVER_PARTITIONS
+    from trino_tpu.server.coordinator import Coordinator
+    from trino_tpu.server.task_worker import TaskWorkerServer
+
+    sql = ("SELECT n_name, count(*) FROM nation "
+           "JOIN region ON n_regionkey = r_regionkey "
+           "GROUP BY n_name ORDER BY n_name")
+    workers = [TaskWorkerServer().start() for _ in range(2)]
+    uris = [w.base_uri for w in workers]
+    co1 = Coordinator(worker_uris=uris).start()
+    died = {}
+    replacement = {}
+
+    def kill(site):
+        # in-process stand-in for SIGKILL at the fault site: the HTTP
+        # server goes away and SystemExit (not an Exception — q.run
+        # cannot catch it) freezes the query thread mid-flight
+        died["t"] = _time.perf_counter()
+        co1.tracker.manifests = None
+        co1.tracker.results = None
+        co1._httpd.shutdown()
+        co1._httpd.server_close()
+        died["closed"] = True
+        raise SystemExit
+
+    def boot_replacement():
+        while "closed" not in died:
+            _time.sleep(0.005)
+        for _ in range(100):    # the dying server's port may linger
+            try:
+                replacement["co"] = Coordinator(
+                    port=co1.port, worker_uris=uris).start()
+                return
+            except OSError:
+                _time.sleep(0.02)
+
+    r0 = FAILOVER_PARTITIONS.value(outcome="resumed")
+    p0 = FAILOVER_PARTITIONS.value(outcome="replayed")
+    faultpoints.reset()
+    faultpoints.install("coordinator.post_stage_commit", callback=kill)
+    try:
+        threading.Thread(target=boot_replacement, daemon=True).start()
+        client = StatementClient(
+            co1.base_uri, session_properties={
+                "retry_policy": "TASK",
+                "retry_initial_delay_ms": "10",
+                "remote_task_timeout": "30"})
+        res = client.execute(sql)
+        wall = _time.perf_counter() - died["t"]
+        if res.state != "FINISHED" or "t" not in died:
+            return {}
+        return {
+            "coordinator_failover_resume_s": wall,
+            "failover_parts_resumed":
+                FAILOVER_PARTITIONS.value(outcome="resumed") - r0,
+            "failover_parts_replayed":
+                FAILOVER_PARTITIONS.value(outcome="replayed") - p0,
+        }
+    finally:
+        faultpoints.reset()
+        co = replacement.get("co")
+        if co is not None:
+            co.stop()
+        for w in workers:
+            w.stop()
+
+
 def _leg_fault(iters: int) -> dict:
     """Fault-tolerant execution recovery overhead: the SAME distributed
     query through two in-process workers, 0 vs 1 injected worker
@@ -400,7 +483,8 @@ def _leg_fault(iters: int) -> dict:
     The fractional slowdown is the price of a mid-query worker death;
     the dict also carries the scrape-side artifacts (task-retry counter
     + per-query peak-memory gauge) so the leg proves /metrics exposes
-    them."""
+    them, and the coordinator-failover mini-leg's resume timing +
+    partition split ride along."""
     import threading
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -464,13 +548,17 @@ def _leg_fault(iters: int) -> dict:
         dead.shutdown()
         for w in workers:
             w.stop()
+    try:
+        failover = _fault_failover_subleg()
+    except Exception:           # noqa: BLE001 — the mini-leg is a
+        failover = {}           # ride-along, never the leg's verdict
     return dict({
         "overhead": max(t_fault / t_ok - 1.0, 0.0),
         "task_retries_total":
             METRICS.counter("trino_tpu_task_retries_total").value(),
         "query_peak_memory_bytes":
             METRICS.gauge("trino_tpu_query_peak_memory_bytes").value(),
-    }, **_cw_keys(cold_ok, t_ok))
+    }, **failover, **_cw_keys(cold_ok, t_ok))
 
 
 def _mpp_ici_subleg(sql: str, nrows: int) -> dict:
@@ -968,6 +1056,12 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False,
                 vals["task_retries"] = d["task_retries_total"]
             if "query_peak_memory_bytes" in d:
                 vals["peak_memory_bytes"] = d["query_peak_memory_bytes"]
+            # fault leg ride-alongs: coordinator-failover resume
+            for k in ("coordinator_failover_resume_s",
+                      "failover_parts_resumed",
+                      "failover_parts_replayed"):
+                if k in d:
+                    vals[k] = d[k]
             # telemetry leg ride-along: OTLP documents the file sink
             # actually accepted during the telemetry-on runs
             if "otlp_exports" in d:
@@ -1233,6 +1327,19 @@ def main():
             cpu_vals.get("task_retries", 0.0) or 0.0, 1),
         "query_peak_memory_bytes": round(
             cpu_vals.get("peak_memory_bytes", 0.0) or 0.0, 1),
+        # mid-flight coordinator failover (fte/faultpoints.py +
+        # recovery.py ExecutionManifestStore): seconds from coordinator
+        # death — injected at coordinator.post_stage_commit after the
+        # first stage commits — to the SAME query FINISHED on a
+        # replacement coordinator, and how many stage partitions were
+        # read off the spool (resumed) vs re-dispatched (replayed)
+        "coordinator_failover_resume_s": round(
+            cpu_vals.get("coordinator_failover_resume_s", 0.0)
+            or 0.0, 4),
+        "failover_partitions_resumed": int(
+            cpu_vals.get("failover_parts_resumed", 0.0) or 0),
+        "failover_partitions_replayed": int(
+            cpu_vals.get("failover_parts_replayed", 0.0) or 0),
         # multi-stage MPP (trino_tpu/stage/): a distributed hash-join +
         # final-aggregation query with joins/aggs executing ON workers
         # (default-on engine since PR 13); rows/s at 3 workers with

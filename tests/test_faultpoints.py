@@ -1,0 +1,135 @@
+"""Fault-point registry unit tests (trino_tpu/fte/faultpoints.py).
+
+The registry is the deterministic half of the chaos harness: a named
+site either does nothing (unarmed — the production state) or performs
+exactly the scheduled action at exactly the scheduled hit. Everything
+the failover tests rely on — skip counts, fire-once, env parsing,
+programmatic installs beating the env — is pinned here in isolation.
+"""
+
+import time
+
+import pytest
+
+from trino_tpu.fte import faultpoints
+from trino_tpu.fte.faultpoints import (FaultInjected, armed_sites,
+                                       fault_point, install,
+                                       parse_schedule)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry(monkeypatch):
+    monkeypatch.delenv(faultpoints.ENV_VAR, raising=False)
+    faultpoints.reset()
+    yield
+    faultpoints.reset()
+
+
+def test_unarmed_site_is_a_noop():
+    fault_point("coordinator.pre_dispatch")     # must not raise
+    fault_point("never.heard.of.it")
+
+
+def test_raise_action_fires_once_then_goes_inert():
+    install("site.a", "raise")
+    with pytest.raises(FaultInjected) as err:
+        fault_point("site.a")
+    assert err.value.site == "site.a"
+    fault_point("site.a")                       # spent: inert now
+
+
+def test_skip_defers_firing_to_the_nth_hit():
+    install("site.b", "raise", skip=2)
+    fault_point("site.b")
+    fault_point("site.b")
+    with pytest.raises(FaultInjected):
+        fault_point("site.b")
+    fault_point("site.b")
+
+
+def test_count_allows_repeat_firing():
+    install("site.c", "raise", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultInjected):
+            fault_point("site.c")
+    fault_point("site.c")
+
+
+def test_delay_action_sleeps_then_continues():
+    install("site.d", "delay", seconds=0.05)
+    t0 = time.perf_counter()
+    fault_point("site.d")
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_callback_runs_and_may_request_raise():
+    seen = []
+    install("site.e", callback=lambda site: seen.append(site))
+    fault_point("site.e")
+    assert seen == ["site.e"]
+
+    install("site.f", callback=lambda site: "raise")
+    with pytest.raises(FaultInjected):
+        fault_point("site.f")
+
+
+def test_parse_schedule_grammar():
+    sched = parse_schedule(
+        "coordinator.post_stage_commit=crash@1, "
+        "worker.pre_status_beat=delay:0.5, spool.pre_marker=raise")
+    assert sched["coordinator.post_stage_commit"].action == "crash"
+    assert sched["coordinator.post_stage_commit"].skip == 1
+    assert sched["worker.pre_status_beat"].action == "delay"
+    assert sched["worker.pre_status_beat"].seconds == 0.5
+    assert sched["spool.pre_marker"].action == "raise"
+
+
+@pytest.mark.parametrize("bad", [
+    "no-equals-sign",
+    "site=frobnicate",           # unknown action
+    "site=call",                 # call is install()-only
+    "=raise",                    # missing site
+    "site=delay:not-a-number",
+    "site=raise@nope",
+])
+def test_parse_schedule_rejects_malformed_specs(bad):
+    with pytest.raises(ValueError):
+        parse_schedule(bad)
+
+
+def test_env_schedule_arms_lazily_and_reset_rearms(monkeypatch):
+    monkeypatch.setenv(faultpoints.ENV_VAR, "site.env=raise")
+    faultpoints.reset()              # forget: env re-read on next use
+    with pytest.raises(FaultInjected):
+        fault_point("site.env")
+    fault_point("site.env")          # spent
+    faultpoints.reset()              # re-arms from env again
+    with pytest.raises(FaultInjected):
+        fault_point("site.env")
+
+
+def test_install_beats_env_schedule(monkeypatch):
+    monkeypatch.setenv(faultpoints.ENV_VAR, "site.g=raise")
+    faultpoints.reset()
+    install("site.g", "delay", seconds=0.0)
+    fault_point("site.g")            # delay(0), NOT the env's raise
+    assert armed_sites()["site.g"] == "delay"
+
+
+def test_armed_sites_lists_env_and_installs(monkeypatch):
+    monkeypatch.setenv(faultpoints.ENV_VAR, "site.h=crash")
+    faultpoints.reset()
+    install("site.i", "raise")
+    sites = armed_sites()
+    assert sites["site.h"] == "crash" and sites["site.i"] == "raise"
+
+
+def test_startup_banner_parses_and_announces(monkeypatch, capsys):
+    from trino_tpu.server.main import _announce_fault_points
+    monkeypatch.setenv(faultpoints.ENV_VAR, "worker.pre_status_beat=delay:0.1")
+    faultpoints.reset()
+    _announce_fault_points()
+    assert "worker.pre_status_beat=delay" in capsys.readouterr().err
+    monkeypatch.setenv(faultpoints.ENV_VAR, "oops")
+    with pytest.raises(ValueError):
+        _announce_fault_points()

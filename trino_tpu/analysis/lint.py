@@ -278,7 +278,13 @@ _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   # PR 15: the OTLP exporter — query threads and the
                   # coordinator's HTTP threads both drive export/
                   # serialization, so its sink state stays reachable
-                  "obs/otlp.py")
+                  "obs/otlp.py",
+                  # PR 17: the fault-point registry — fault_point()
+                  # fires from scheduler dispatch threads, worker HTTP
+                  # threads and spool commit paths alike; already under
+                  # the fte/ prefix, listed explicitly so narrowing
+                  # that prefix can never silently drop it
+                  "fte/faultpoints.py")
 
 
 class _CrossIndex:

@@ -4,11 +4,22 @@ Reference parity: client/trino-client StatementClientV1.java:108,324-336
 — POST /v1/statement, then advance() through nextUri until the payload
 carries no nextUri; data rows accumulate across pages. stdlib-only
 (urllib), synchronous.
+
+nextUri polls retry transient transport failures (connection refused /
+reset, HTTP 503) with bounded exponential backoff, like the reference
+client's advance() loop: a coordinator failover — the old process dead,
+its replacement binding the same port and resuming the query from the
+spooled execution manifest — looks to the client like a brief outage in
+the middle of an otherwise ordinary poll chain. The initial POST is NOT
+retried: submission is not idempotent.
 """
 
 from __future__ import annotations
 
+import http.client
 import json
+import time
+import urllib.error
 import urllib.request
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -36,13 +47,21 @@ class StatementClient:
     def __init__(self, base_uri: str, user: str = "user",
                  catalog: str = "tpch", schema: str = "tiny",
                  session_properties: Optional[Dict[str, str]] = None,
-                 timeout: float = 600.0):
+                 timeout: float = 600.0, poll_retries: int = 8,
+                 poll_retry_delay: float = 0.05):
         self.base_uri = base_uri.rstrip("/")
         self.user = user
         self.catalog = catalog
         self.schema = schema
         self.session_properties = dict(session_properties or {})
         self.timeout = timeout
+        # transient-failure budget for one nextUri poll: attempts and
+        # the initial backoff (doubled per retry, capped at 1s). ~2.5s
+        # of cumulative patience at the defaults — enough to ride out
+        # a coordinator restart, short enough that a dead cluster
+        # still fails fast
+        self.poll_retries = max(0, int(poll_retries))
+        self.poll_retry_delay = float(poll_retry_delay)
         # client-held prepared statements, replayed on every request
         # via X-Trino-Prepared-Statement (ProtocolHeaders.java — the
         # coordinator's sessions are per-request, so prepared state
@@ -88,7 +107,30 @@ class StatementClient:
             if not nxt:
                 self._track_prepared(sql, out)
                 return out
-            payload = self._request("GET", nxt)
+            payload = self._poll(nxt)
+
+    def _poll(self, uri: str) -> dict:
+        """One nextUri advance with bounded retry. GET on an executing
+        URI is idempotent (the token addresses the page), so retrying
+        it can duplicate no rows — unlike the initial POST."""
+        delay = self.poll_retry_delay
+        for attempt in range(self.poll_retries + 1):
+            try:
+                return self._request("GET", uri)
+            except urllib.error.HTTPError as e:
+                # 503 = overloaded / restarting, worth the wait; any
+                # other status is an answer, not an outage
+                if e.code != 503 or attempt >= self.poll_retries:
+                    raise
+            except (urllib.error.URLError, ConnectionError,
+                    http.client.HTTPException) as e:
+                if attempt >= self.poll_retries:
+                    raise ClientError(
+                        f"giving up on {uri} after "
+                        f"{self.poll_retries + 1} attempts: {e}") from e
+            time.sleep(delay)
+            delay = min(delay * 2, 1.0)
+        raise ClientError(f"unreachable poll state for {uri}")
 
     def _track_prepared(self, sql: str, out: ClientResult) -> None:
         """Keep the client-side prepared-statement registry in sync

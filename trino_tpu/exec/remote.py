@@ -109,13 +109,26 @@ class RemoteScheduler:
                  collect_stats: bool = False,
                  failure_detector=None, spool=None,
                  worker_supplier: Optional[
-                     Callable[[], List[str]]] = None):
+                     Callable[[], List[str]]] = None,
+                 manifest_store=None, manifest_meta=None):
         if not worker_uris:
             raise ValueError("RemoteScheduler needs at least one worker")
         from ..server.task_worker import RemoteTaskClient
         self.workers = [RemoteTaskClient(u) for u in worker_uris]
         self.catalogs = catalogs
         self.session = session
+        # mid-flight failover (fte/recovery.py ExecutionManifestStore):
+        # when both are wired and the retry policy allows resumption,
+        # the stage path persists an execution manifest BEFORE
+        # dispatching any task. ``manifest_meta`` carries the
+        # coordinator-side identity/admission facts (query id, slug,
+        # SQL, user, resource group, original submit epoch) the
+        # scheduler itself does not know.
+        self.manifest_store = manifest_store
+        self.manifest_meta = manifest_meta
+        # failover-resume accounting for the most recent stage run
+        self.failover_resumed = 0
+        self.failover_replayed = 0
         # distributed stats rollup: workers report per-node stats in
         # task results; after execute_plan, fragment_stats[fid] holds
         # the per-stage merge and self.stats the full rollup (fragment
@@ -458,19 +471,43 @@ class RemoteScheduler:
         except KeyError:        # foreign session without the knob
             return False
 
-    def _execute_stages(self, dag, payloads: Dict[int, dict]) -> Batch:
+    def _execute_stages(self, dag, payloads: Dict[int, dict],
+                        resume: Optional[dict] = None) -> Batch:
         """Stage-DAG execution: every worker stage runs through the
         topological stage scheduler (stage/scheduler.py) with the
         partitioned exchange riding the workers' spools; the
         coordinator then executes ONLY the root plan, pulling the
         final gather partition from the last stage's tasks — under
-        the same combine retry loop as the flat path."""
+        the same combine retry loop as the flat path.
+
+        ``resume`` (coordinator failover, fte/recovery.py): a dict of
+        ``{"exec_qid", "ntasks", "spool"}`` reconstructed from a
+        spooled execution manifest — the stage scheduler then reuses
+        the ORIGINAL execution id (exchange keys must match the
+        partitions earlier attempts committed), pins the original
+        fan-out, and dispatches only the partitions whose exchange
+        keys carry no COMMITTED marker."""
         from ..stage.exchange import ExchangePuller
         from ..stage.scheduler import StageExecution
+        from ..fte.faultpoints import fault_point
         self.stage_dag = dag
         self.stage_lines = dag.lines()
-        sx = StageExecution(self, dag, payloads)
+        if resume is not None:
+            sx = StageExecution(
+                self, dag, payloads, qid=str(resume["exec_qid"]),
+                ntasks_override={int(k): int(v) for k, v in
+                                 (resume.get("ntasks") or {}).items()},
+                resume_spool=resume.get("spool"))
+        else:
+            sx = StageExecution(self, dag, payloads)
+            self._persist_manifest(dag, payloads, sx)
+        # deterministic chaos site: the manifest (when one was written)
+        # is durable, no task has been dispatched — a crash here leaves
+        # a fully-replayable query
+        fault_point("coordinator.pre_dispatch")
         sources = sx.run()
+        self.failover_resumed = sx.resumed_parts
+        self.failover_replayed = sx.replayed_parts
         timeout_s = float(self.session.get("remote_task_timeout"))
         # spool-first root gather: on a shared local spool base the
         # coordinator reads the final stage's committed partitions
@@ -534,6 +571,43 @@ class RemoteScheduler:
             self.stats.extend(ex.stats)
         return out
 
+    def _persist_manifest(self, dag, payloads: Dict[int, dict],
+                          sx) -> None:
+        """Spool the execution manifest for mid-flight failover —
+        everything a coordinator that never saw this query needs to
+        finish it (fte/recovery.py ExecutionManifestStore). Gated the
+        same way spooling itself is: retry_policy=NONE queries are not
+        resumable, exactly as they get no task retries. Best-effort by
+        contract — a failed persist costs only resumability."""
+        if self.manifest_store is None or not self.manifest_meta:
+            return
+        if not RetryPolicy.from_session(self.session).enabled:
+            return
+        try:
+            doc = dict(self.manifest_meta)
+            doc.update({
+                "execId": sx.qid,
+                "catalog": self.session.catalog,
+                "schema": self.session.schema,
+                "properties": dict(self.session.properties),
+                "ntasks": {str(k): int(v)
+                           for k, v in sx.ntasks.items()},
+                "stages": [{
+                    "sid": st.sid,
+                    "inputs": list(st.inputs),
+                    "consumer": st.consumer,
+                    "maxTasks": st.max_tasks,
+                    # the serde-proven wire encoding the scheduler
+                    # ships (analysis/sanity.py validate_fragment
+                    # round-trip-checked these exact bytes)
+                    "payload": payloads[st.sid],
+                } for st in dag.stages],
+                "rootPlan": to_jsonable(dag.root_plan),
+            })
+            self.manifest_store.persist(doc)
+        except Exception:       # noqa: BLE001 — resumability is
+            pass                # opportunistic, never a query failure
+
     def _execute_combine(self, final: PlanNode, setup=None):
         """The root (combine) stage with its own retry loop: under
         retry_policy=TASK the combine re-executes on the coordinator
@@ -547,6 +621,13 @@ class RemoteScheduler:
         user cancel or a deterministic ``QueryError`` is never
         retried."""
         import time as _time
+        from ..fte.faultpoints import fault_point
+        # deterministic chaos site: every input the combine needs is
+        # durable (stage output committed / fragments gathered), only
+        # the root execution and result publication remain — fired
+        # BEFORE the retry loop so an injected raise is a coordinator
+        # failure, not a retriable combine error
+        fault_point("coordinator.mid_combine")
         policy = RetryPolicy.from_session(self.session)
         attempts = (max(policy.task_retry_attempts, 1)
                     if policy.enabled else 1)
@@ -1222,7 +1303,8 @@ class DistributedHostQueryRunner:
                  collect_node_stats: bool = False,
                  failure_detector=None, spool=None,
                  worker_supplier: Optional[
-                     Callable[[], List[str]]] = None):
+                     Callable[[], List[str]]] = None,
+                 manifest_store=None, manifest_meta=None):
         from ..runner import LocalQueryRunner
         self._local = LocalQueryRunner(session=session,
                                        catalogs=catalogs)
@@ -1239,6 +1321,13 @@ class DistributedHostQueryRunner:
         self.failure_detector = failure_detector
         self.spool = spool
         self.worker_supplier = worker_supplier
+        # mid-flight failover plumbing (fte/recovery.py): when wired,
+        # stage-DAG dispatches spool an execution manifest first
+        self.manifest_store = manifest_store
+        self.manifest_meta = manifest_meta
+        # failover-resume accounting of the last execute()/resume()
+        self.failover_resumed = 0
+        self.failover_replayed = 0
 
     def execute(self, sql: str):
         import time as _time
@@ -1283,9 +1372,13 @@ class DistributedHostQueryRunner:
                 collect_stats=collect,
                 failure_detector=self.failure_detector,
                 spool=self.spool,
-                worker_supplier=self.worker_supplier)
+                worker_supplier=self.worker_supplier,
+                manifest_store=self.manifest_store,
+                manifest_meta=self.manifest_meta)
             with sp("execute"):
                 batch = sched.execute_plan(plan)
+            self.failover_resumed = sched.failover_resumed
+            self.failover_replayed = sched.failover_replayed
         finally:
             self.session.trace = prev_trace
             # same latency histogram LocalQueryRunner feeds, in the
@@ -1332,6 +1425,72 @@ class DistributedHostQueryRunner:
         res.device_seconds = sched.device_seconds
         if self.collect_node_stats:
             res.stats = sched.stats
+        return res
+
+    def resume(self, manifest: dict, resume_spool=None):
+        """Finish a RUNNING query from its spooled execution manifest
+        (coordinator failover; fte/recovery.py). The stage DAG is
+        rebuilt from the manifest's serde-proven wire encodings, the
+        ORIGINAL execution id and fan-out are pinned (exchange keys
+        must address the partitions earlier attempts committed), and
+        only partitions without a COMMITTED marker are dispatched —
+        then the combine re-runs and the result is assembled exactly
+        like a first-run query's.
+
+        ``resume_spool`` is the spool the WORKERS committed exchange
+        output to; defaults to the shared local worker spool base."""
+        import time as _time
+        from ..obs.metrics import QUERY_WALL_SECONDS
+        from ..plan.serde import from_jsonable
+        from ..runner import QueryResult
+        from ..stage.fragmenter import Stage, StageDAG
+        t0 = _time.perf_counter()
+        stages = []
+        payloads: Dict[int, dict] = {}
+        for rec in manifest.get("stages") or []:
+            sid = int(rec["sid"])
+            payloads[sid] = rec["payload"]
+            stages.append(Stage(
+                sid=sid, plan=from_jsonable(rec["payload"]),
+                inputs=tuple(int(i) for i in (rec.get("inputs") or ())),
+                consumer=(None if rec.get("consumer") is None
+                          else int(rec["consumer"])),
+                max_tasks=(None if rec.get("maxTasks") is None
+                           else int(rec["maxTasks"]))))
+        if not stages:
+            raise QueryError("execution manifest carries no stages")
+        stages.sort(key=lambda st: st.sid)
+        root = from_jsonable(manifest["rootPlan"])
+        dag = StageDAG(stages, root)
+        if resume_spool is None:
+            from ..fte.spool import make_spool, worker_spool_base
+            resume_spool = make_spool(
+                "local", local_base_dir=worker_spool_base())
+        sched = RemoteScheduler(
+            self.worker_uris, self.catalogs, self.session,
+            collect_stats=self.collect_node_stats,
+            failure_detector=self.failure_detector,
+            spool=self.spool,
+            worker_supplier=self.worker_supplier)
+        try:
+            batch = sched._execute_stages(
+                dag, payloads,
+                resume={"exec_qid": manifest["execId"],
+                        "ntasks": manifest.get("ntasks") or {},
+                        "spool": resume_spool})
+        finally:
+            QUERY_WALL_SECONDS.observe(_time.perf_counter() - t0)
+        self.failover_resumed = sched.failover_resumed
+        self.failover_replayed = sched.failover_replayed
+        schema = batch.schema()
+        types = [schema[s] for s in root.symbols]
+        res = QueryResult(list(root.names), types, batch.to_pylist())
+        res.peak_memory_bytes = sched.peak_memory_bytes
+        res.spill_bytes = sched.spill_bytes
+        res.stream_chunks = sched.stream_chunks
+        res.stream_h2d_bytes = sched.stream_h2d_bytes
+        res.cpu_seconds = sched.cpu_seconds
+        res.device_seconds = sched.device_seconds
         return res
 
 

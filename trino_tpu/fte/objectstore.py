@@ -39,6 +39,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..obs.metrics import METRICS
+from .faultpoints import fault_point
 from .spool import (_M_SPOOL_DUPES, _M_SPOOL_READ, _M_SPOOL_WRITTEN,
                     SpoolManager)
 
@@ -221,6 +222,7 @@ class ObjectStoreSpool(SpoolManager):
             self._retry("put", lambda k=f"{apre}/page_{i:05d}",
                         d=frame: self.store.put(k, d))
         marker = f"{tpre}/COMMITTED"
+        fault_point("spool.pre_marker")
         won = self._retry("put", lambda: self.store.put_if_absent(
             marker, str(attempt).encode()))
         if won:
@@ -300,6 +302,13 @@ class ObjectStoreSpool(SpoolManager):
         try:
             self._retry("delete", lambda: self.store.delete_prefix(
                 f"{query_id}/"))
+        except TransientObjectStoreError:
+            pass                  # the TTL sweep backstops a failed drop
+
+    def release_fragment(self, query_id: str, fragment_id: int) -> None:
+        try:
+            self._retry("delete", lambda: self.store.delete_prefix(
+                f"{query_id}/f{fragment_id}.p"))
         except TransientObjectStoreError:
             pass                  # the TTL sweep backstops a failed drop
 

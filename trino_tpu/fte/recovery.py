@@ -36,6 +36,12 @@ from ..obs.metrics import METRICS
 # spools under this reserved id (layout: <query_id>/f-1.p0/...)
 RESULT_FRAGMENT = -1
 
+# the EXECUTION manifest — everything a restarted coordinator needs to
+# resume a RUNNING query — spools under this second reserved id
+# (layout: <query_id>/f-2.p0/...), written at dispatch time and
+# released on normal completion
+MANIFEST_FRAGMENT = -2
+
 # rows per persisted result frame — matches the coordinator's
 # QueryResults paging so one frame serves ~one client page
 RESULT_PAGE_ROWS = 4096
@@ -52,6 +58,14 @@ _M_RESULTS_SKIPPED = METRICS.counter(
     "trino_tpu_query_results_spool_skipped_total",
     "Finished queries whose results exceeded result_spool_max_bytes "
     "and were not persisted for restart recovery")
+_M_MANIFESTS_PERSISTED = METRICS.counter(
+    "trino_tpu_exec_manifests_spooled_total",
+    "Execution manifests spooled at dispatch time for mid-flight "
+    "coordinator-failover resumption")
+_M_MANIFESTS_RESUMED = METRICS.counter(
+    "trino_tpu_exec_manifests_resumed_total",
+    "RUNNING queries resumed from a spooled execution manifest by a "
+    "coordinator that did not dispatch them")
 
 
 def json_value(v):
@@ -214,5 +228,78 @@ class ResultStore:
     def release(self, query_id: str) -> None:
         try:
             self.spool.release(query_id)
+        except Exception:       # noqa: BLE001
+            pass
+
+
+class ExecutionManifestStore:
+    """Persists / reloads the EXECUTION manifest of a RUNNING query —
+    the mid-flight counterpart of ``ResultStore``.
+
+    The manifest is written once, at dispatch time, after the stage DAG
+    has been fragmented, serde-proven (``validate_stage_dag`` returns
+    the round-trip-checked wire encodings) and its fan-out decided, but
+    BEFORE any task is dispatched. It carries everything a coordinator
+    that never saw the query needs to finish it: identity (query id,
+    slug, SQL), admission context (user, catalog, schema, session
+    properties, resource group + weight), timing (original submit and
+    start epochs — a resume must not reset the query deadline), the
+    execution id the stage scheduler keyed its exchange spool entries
+    under, the per-stage fan-out, and the wire encoding of every stage
+    fragment plus the root (combine) plan.
+
+    Stage progress itself is NOT in the manifest: the stage exchange's
+    first-commit-wins COMMITTED markers (keyed ``<exec>.s<sid>.p<part>``)
+    are the durable progress log, and the resuming coordinator
+    enumerates them directly."""
+
+    def __init__(self, spool):
+        self.spool = spool
+
+    def persist(self, doc: dict) -> bool:
+        """Spool one execution manifest (a JSON document built by the
+        dispatch path). Best-effort: a failed persist costs only
+        failover resumability, never the query."""
+        query_id = str(doc.get("queryId"))
+        try:
+            frames = [json.dumps(doc).encode()]
+            self.spool.commit(query_id, MANIFEST_FRAGMENT, 0, 0, frames)
+        except Exception:       # noqa: BLE001
+            return False
+        _M_MANIFESTS_PERSISTED.inc()
+        return True
+
+    def load(self, query_id: str,
+             slug: Optional[str] = None) -> Optional[dict]:
+        """Reload a manifest, or None if nothing (or something
+        unreadable) is spooled. ``slug`` is checked against the
+        manifest when given — a wrong-slug probe must 404, not leak a
+        foreign query's plan."""
+        try:
+            raw = self.spool.read_frame(query_id, MANIFEST_FRAGMENT,
+                                        0, 0)
+        except Exception:       # noqa: BLE001
+            return None
+        if raw is None:
+            return None
+        try:
+            doc = json.loads(raw)
+        except ValueError:
+            return None
+        if not isinstance(doc, dict):
+            return None
+        if slug is not None and str(doc.get("slug")) != slug:
+            return None
+        return doc
+
+    def mark_resumed(self) -> None:
+        _M_MANIFESTS_RESUMED.inc()
+
+    def release(self, query_id: str) -> None:
+        """Drop ONLY the manifest fragment: the finished result persists
+        under the same query id and must survive (``spool.release``
+        would tombstone the whole query)."""
+        try:
+            self.spool.release_fragment(query_id, MANIFEST_FRAGMENT)
         except Exception:       # noqa: BLE001
             pass

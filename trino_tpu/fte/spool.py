@@ -40,6 +40,7 @@ import time
 from typing import List, Optional
 
 from ..obs.metrics import METRICS
+from .faultpoints import fault_point
 
 _M_SPOOL_WRITTEN = METRICS.counter(
     "trino_tpu_spool_bytes_written_total",
@@ -85,6 +86,13 @@ class SpoolManager:
 
     def release(self, query_id: str) -> None:
         """Drop a finished query's spool."""
+        raise NotImplementedError
+
+    def release_fragment(self, query_id: str, fragment_id: int) -> None:
+        """Drop ONE fragment's spool entries without tombstoning the
+        query — reserved-fragment bookkeeping (the execution manifest)
+        is released on completion while the persisted result under the
+        same query id must stay servable."""
         raise NotImplementedError
 
     def cleanup(self, now: Optional[float] = None) -> int:
@@ -343,6 +351,7 @@ class LocalDirSpool(SpoolManager):
         # the marker is hard-linked from a fully written temp file, so
         # claiming (O_EXCL semantics of link) and content are one
         # atomic step — a crash can never leave an empty marker
+        fault_point("spool.pre_marker")
         marker = os.path.join(tdir, "COMMITTED")
         tmpm = f"{marker}.tmp{os.getpid()}.{threading.get_ident()}"
         with open(tmpm, "w") as f:
@@ -452,6 +461,18 @@ class LocalDirSpool(SpoolManager):
         self._mark_released(query_id)
         shutil.rmtree(os.path.join(self.base, str(query_id)),
                       ignore_errors=True)
+
+    def release_fragment(self, query_id: str, fragment_id: int) -> None:
+        qdir = os.path.join(self.base, str(query_id))
+        try:
+            entries = os.listdir(qdir)
+        except OSError:
+            return
+        prefix = f"f{fragment_id}.p"
+        for name in entries:
+            if name.startswith(prefix):
+                shutil.rmtree(os.path.join(qdir, name),
+                              ignore_errors=True)
 
     def cleanup(self, now: Optional[float] = None) -> int:
         now = time.time() if now is None else now

@@ -347,6 +347,14 @@ EXCHANGE_PARTITION_BYTES = METRICS.counter(
 STAGES_SCHEDULED = METRICS.counter(
     "trino_tpu_stages_scheduled_total",
     "Worker stages dispatched by the stage-DAG scheduler")
+# coordinator failover (stage/scheduler.py resume mode): per resumed
+# query, stage partitions already COMMITTED on the exchange spool are
+# "resumed" (served off spool, zero re-execution); the rest are
+# "replayed" (re-dispatched)
+FAILOVER_PARTITIONS = METRICS.counter(
+    "trino_tpu_failover_partitions_total",
+    "Stage partitions handled during coordinator-failover resume by "
+    "outcome", ("outcome",))
 # eager stage pipelining (stage/scheduler.py): the last query's share
 # of exchange-connected wall time where tasks of >= 2 different stages
 # ran concurrently (0 under the per-stage barrier; the bench mpp leg's

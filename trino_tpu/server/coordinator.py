@@ -200,11 +200,19 @@ class QueryTracker:
     lifecycle events (event/QueryMonitor.java:130,206)."""
 
     def __init__(self, make_runner, events=None, resource_groups=None,
-                 result_store=None, memory=None):
+                 result_store=None, memory=None, manifest_store=None):
         from .events import EventListenerManager
         self._queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
         self._counter = itertools.count(1)
+        # per-tracker instance token baked into every query id (the
+        # reference id's trailing coordinator component,
+        # QueryId "yyyyMMdd_HHmmss_index_coordId"): the counter resets
+        # with the process, so two coordinators started within the
+        # same wall-clock second would otherwise mint COLLIDING ids —
+        # and colliding ids share one spool directory, letting query
+        # A's persisted results shadow query B's execution manifest
+        self._instance = uuid.uuid4().hex[:5]
         self._make_runner = make_runner
         self.events = events or EventListenerManager()
         self.groups = resource_groups
@@ -218,13 +226,17 @@ class QueryTracker:
         # queries persist their combine output + manifest here so a
         # client can re-pull results from a NEW coordinator process
         self.results = result_store
+        # mid-flight failover (fte/recovery.py ExecutionManifestStore):
+        # execution manifests spooled at dispatch time, released here
+        # once the query is terminal (any state — a finished, failed or
+        # canceled query must not be resumable by a later coordinator)
+        self.manifests = manifest_store
 
     def submit(self, sql: str, session: Session,
                source: str = "") -> _Query:
-        from .events import QueryCreatedEvent, QueryCompletedEvent
-        from .resourcegroups import QueryQueueFullError
+        from .events import QueryCreatedEvent
         qid = (time.strftime("%Y%m%d_%H%M%S") +
-               f"_{next(self._counter):05d}")
+               f"_{next(self._counter):05d}_{self._instance}")
         q = _Query(qid, uuid.uuid4().hex[:16], sql, session)
         q.source = source
         # stamp the session so the executor's split-completion path and
@@ -237,7 +249,44 @@ class QueryTracker:
         _M_STATES.inc(state="QUEUED")
         self.events.query_created(QueryCreatedEvent(
             qid, sql, session.user, session.catalog, session.schema))
+        self._arm_deadline(q, session)
+        self._launch(q, session, source)
+        return q
 
+    def submit_resumed(self, q: _Query, runner_factory) -> _Query:
+        """Register and dispatch an already-rebuilt query — the
+        mid-flight half of coordinator failover (Coordinator.
+        resume_query built ``q`` from the spooled execution manifest
+        with its ORIGINAL id, slug, sql, session and submit/start
+        times). First registration wins: two clients whose polls both
+        miss must converge on ONE resumed execution. The returned
+        query is the registered one (which may be a concurrent
+        winner's, or even a plain recover_query entry that landed
+        first).
+
+        Resumption goes through the full admission path: the deadline
+        re-arms against the ORIGINAL submit time (a resume must not
+        extend query_max_run_time) and ``_launch`` routes through the
+        resource-group manager and cluster memory registration exactly
+        like a fresh submit — a failed-over query competes for slots,
+        it does not jump the queue."""
+        from .events import QueryCreatedEvent
+        session = q.session
+        session.query_id = q.query_id
+        session.events = self.events
+        with self._lock:
+            registered = self._queries.setdefault(q.query_id, q)
+        if registered is not q:
+            return registered
+        _M_STATES.inc(state="QUEUED")
+        self.events.query_created(QueryCreatedEvent(
+            q.query_id, q.sql, session.user, session.catalog,
+            session.schema))
+        self._arm_deadline(q, session)
+        self._launch(q, session, q.source, runner_factory=runner_factory)
+        return q
+
+    def _arm_deadline(self, q: _Query, session: Session) -> None:
         limit = int(session.get("query_max_run_time") or 0)
         if limit > 0:
             # QUERY_MAX_RUN_TIME enforcement, armed at SUBMIT: the
@@ -270,8 +319,23 @@ class QueryTracker:
             q.deadline_timer.daemon = True
             q.deadline_timer.start()
 
+    def _launch(self, q: _Query, session: Session, source: str,
+                runner_factory=None) -> None:
+        """Admission + execution of one registered query:
+        resource-group routing, memory registration, the run thread,
+        and every piece of terminal bookkeeping. ``runner_factory``
+        (default: the coordinator's) lets a failover resume substitute
+        a manifest-driven runner without forking this machinery."""
+        from .events import QueryCompletedEvent
+        from .resourcegroups import QueryQueueFullError
+        qid = q.query_id
+
         def run_and_release():
-            q.started = time.time()  # tt-lint: ignore[race-attr-write] single stamp before the query publishes; readers tolerate None
+            if q.started is None:
+                # resumed queries arrive with the ORIGINAL admission
+                # stamp from the manifest — queued/elapsed accounting
+                # must span coordinators, not reset per process
+                q.started = time.time()  # tt-lint: ignore[race-attr-write] single stamp before the query publishes; readers tolerate None
             if q.group is not None:
                 # the admitting group's identity + scheduling weight
                 # ride the session so remote/stage task payloads carry
@@ -311,11 +375,19 @@ class QueryTracker:
                     # the entry so it cannot be recovered as FINISHED
                     self.results.release(query.query_id)
             try:
-                q.run(self._make_runner, on_result=persist,
-                      on_discard=discard)
+                q.run(runner_factory or self._make_runner,
+                      on_result=persist, on_discard=discard)
             finally:
                 if q.deadline_timer is not None:
                     q.deadline_timer.cancel()
+                if self.manifests is not None:
+                    # terminal in ANY state: the execution manifest
+                    # exists only to let another coordinator finish a
+                    # RUNNING query — once this one reached a verdict
+                    # the manifest must not outlive it. The spooled
+                    # RESULT (fragment -1) survives; release_fragment
+                    # drops only f-2.
+                    self.manifests.release(qid)
                 if self.memory is not None:
                     self.memory.unregister(qid)
                     session.memory = None
@@ -424,7 +496,6 @@ class QueryTracker:
                     q.query_id, q.sql, q.session.user, "FAILED",
                     0.0, error_name="QUERY_QUEUE_FULL",
                     error_message=str(e)))
-        return q
 
     def get(self, qid: str) -> Optional[_Query]:
         with self._lock:
@@ -511,9 +582,16 @@ class Coordinator:
             from ..fte.spool import make_spool
             self.spool = make_spool(spool_backend)
         self.results = None
+        self.manifests = None
         if self.spool is not None:
-            from ..fte.recovery import ResultStore
+            from ..fte.recovery import (ExecutionManifestStore,
+                                        ResultStore)
             self.results = ResultStore(self.spool)
+            # mid-flight failover: execution manifests for RUNNING
+            # queries live on the SERVER spool (like results — recovery
+            # durability is a coordinator property, not a per-query
+            # spool_backend choice)
+            self.manifests = ExecutionManifestStore(self.spool)
 
         # one shared CatalogManager (memory-connector state spans
         # queries) and one shared mesh
@@ -537,11 +615,35 @@ class Coordinator:
                 if backend:
                     from ..fte.spool import default_spool
                     spool = default_spool(backend)
+                # mid-flight failover: hand the runner the manifest
+                # store plus the tracked query's identity/admission/
+                # timing context; the runner persists the full
+                # execution manifest (stage payloads + fan-out) at
+                # dispatch time, once the DAG is serde-proven
+                meta = None
+                if self.manifests is not None:
+                    tq = self.tracker.get(
+                        getattr(session, "query_id", "") or "")
+                    if tq is not None:
+                        meta = {
+                            "queryId": tq.query_id,
+                            "slug": tq.slug,
+                            "sql": tq.sql,
+                            "user": session.user,
+                            "source": tq.source,
+                            "resourceGroup": getattr(
+                                tq.group, "full_name", "global")
+                            if tq.group is not None else "global",
+                            "submitEpoch": tq.created,
+                            "startedEpoch": tq.started,
+                        }
                 return DistributedHostQueryRunner(
                     live, session=session, catalogs=self._catalogs,
                     collect_node_stats=True,
                     failure_detector=self.failure_detector,
                     spool=spool,
+                    manifest_store=self.manifests,
+                    manifest_meta=meta,
                     # live membership: mid-query joins become retry /
                     # speculation targets (exec/remote.py syncs this
                     # before every replacement dispatch)
@@ -576,10 +678,14 @@ class Coordinator:
             from .memory import ClusterMemoryManager, ClusterMemoryPool
             self.memory = ClusterMemoryManager(
                 ClusterMemoryPool(int(pool_bytes)))
+        # resume_query builds manifest-driven runners through the same
+        # factory (live membership, failure detector, spool wiring)
+        self._make_runner = make_runner
         self.tracker = QueryTracker(make_runner, events,
                                     resource_groups,
                                     result_store=self.results,
-                                    memory=self.memory)
+                                    memory=self.memory,
+                                    manifest_store=self.manifests)
         self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
@@ -688,12 +794,15 @@ class Coordinator:
             if self.spool is None:
                 # first worker ever: the cluster just became
                 # distributed — it needs the spool (and with it
-                # restart recovery)
-                from ..fte.recovery import ResultStore
+                # restart recovery and mid-flight failover)
+                from ..fte.recovery import (ExecutionManifestStore,
+                                            ResultStore)
                 from ..fte.spool import make_spool
                 self.spool = make_spool()
                 self.results = ResultStore(self.spool)
                 self.tracker.results = self.results
+                self.manifests = ExecutionManifestStore(self.spool)
+                self.tracker.manifests = self.manifests
         _M_WORKER_JOINS.inc()
         return True
 
@@ -742,6 +851,87 @@ class Coordinator:
             # counted here, not in ResultStore.load: a slug-mismatch
             # probe or a losing concurrent load is not a recovery
             _M_RESULTS_RECOVERED.inc()
+        return registered
+
+    # ---- mid-flight query resumption (coordinator failover) ----------
+    def resume_query(self, query_id: str,
+                     slug: Optional[str] = None) -> Optional[_Query]:
+        """Finish a RUNNING query dispatched by a coordinator that
+        died: the mid-flight half of failover, next to
+        ``recover_query``'s FINISHED half. The execution manifest
+        spooled at dispatch time carries the stage DAG's serde-proven
+        wire payloads, the fan-out, the session/admission context and
+        the ORIGINAL submit/start times; stage progress is read off
+        the exchange spool's first-commit-wins COMMITTED markers, so
+        only the partitions the dead coordinator had NOT committed are
+        re-dispatched (exec/remote.py resume + stage/scheduler.py
+        resume_spool).
+
+        Gated on retry_policy=TASK (the manifest is only written under
+        it, and a NONE query's fragments never touch the spool — there
+        is nothing safe to resume). Returns None when no slug-matching
+        manifest exists, resumption is gated off, or no workers are
+        live; the caller falls through to 404 and the client's retry
+        loop keeps polling."""
+        if self.manifests is None:
+            return None
+        mf = self.manifests.load(query_id, slug)
+        if mf is None:
+            return None
+        if not self.live_workers():
+            return None
+        session = Session(catalog=mf.get("catalog"),
+                          schema=mf.get("schema"),
+                          user=str(mf.get("user") or "user"))
+        for name, value in (mf.get("properties") or {}).items():
+            try:
+                session.set(str(name), value)
+            except (KeyError, TypeError, ValueError):
+                continue    # property from a newer/older build
+        from ..fte.retry import RetryPolicy
+        if not RetryPolicy.from_session(session).enabled:
+            return None
+        q = _Query(str(mf.get("queryId") or query_id),
+                   str(mf.get("slug")), str(mf.get("sql") or ""),
+                   session)
+        q.source = str(mf.get("source") or "")
+        # original-time accounting: queued/elapsed/deadline anchor at
+        # the FIRST coordinator's submit — failover must not hand the
+        # query a fresh query_max_run_time budget
+        try:
+            q.created = float(mf.get("submitEpoch") or q.created)
+        except (TypeError, ValueError):
+            pass
+        q.submit_mono = time.monotonic() - max(
+            time.time() - q.created, 0.0)
+        started = mf.get("startedEpoch")
+        if started:
+            try:
+                q.started = float(started)
+            except (TypeError, ValueError):
+                pass
+        make_runner = self._make_runner
+
+        def resume_runner_factory(sess: Session):
+            runner = make_runner(sess)
+
+            class _ResumeRunner:
+                """execute() ignores the SQL text: the plan was
+                fragmented, proven and spooled by the dead
+                coordinator; re-planning here could fragment
+                differently and orphan the committed partitions."""
+
+                def execute(self, _sql: str):
+                    return runner.resume(mf)
+
+            return _ResumeRunner()
+
+        registered = self.tracker.submit_resumed(q, resume_runner_factory)
+        if registered is q:
+            # counted only for the registration winner: a losing
+            # concurrent resume (or one beaten by recover_query) did
+            # not resume anything
+            self.manifests.mark_resumed()
         return registered
 
     def recovered_query_detail(self, query_id: str) -> Optional[dict]:
@@ -1295,6 +1485,12 @@ def _make_handler(co: Coordinator):
                     # process ran: rebuild it from the spooled manifest
                     # (slug-checked) and keep paging
                     q = co.recover_query(parts[3], parts[4])
+                if q is None:
+                    # no FINISHED result on the spool — the old
+                    # coordinator died MID-FLIGHT: resume the RUNNING
+                    # query from its execution manifest and let this
+                    # very poll become the long-poll on the resumed run
+                    q = co.resume_query(parts[3], parts[4])
                 if q is None or q.slug != parts[4]:
                     self._send(404, {"error": "no such query"})
                     return
@@ -1334,6 +1530,22 @@ def _make_handler(co: Coordinator):
                         owner = str(mf.get("slug")) if mf else None
                     if slug is not None and slug == owner:
                         co.results.release(parts[3])
+                        if co.manifests is not None:
+                            # an abandoned query must not be resumable
+                            # by whoever probes its id later
+                            co.manifests.release(parts[3])
+                    elif slug is not None and co.manifests is not None:
+                        # untracked or owned under a different slug:
+                        # the presented slug may still match the
+                        # EXECUTION manifest (old coordinator died
+                        # mid-flight, client gives up instead of
+                        # resuming). Gated on ITS OWN slug so it can
+                        # be reaped even when a same-id result
+                        # artifact answers to a different owner, and
+                        # never reaps anyone else's
+                        if co.manifests.load(parts[3],
+                                             slug=slug) is not None:
+                            co.manifests.release(parts[3])
                 # 204 carries no body (RFC 7230; a body would desync
                 # keep-alive clients)
                 self.send_response(204)

@@ -178,6 +178,7 @@ def main(argv=None) -> int:
         co.failure_detector.start()
     print(f"trino-tpu coordinator listening on {co.base_uri}"
           f" (web UI: {co.base_uri}/ui)")
+    _announce_fault_points()
 
     stop = {"flag": False}
 
@@ -192,6 +193,23 @@ def main(argv=None) -> int:
     while not stop["flag"]:
         time.sleep(0.2)
     return 0
+
+
+def _announce_fault_points() -> None:
+    """Startup banner for TRINO_TPU_FAULTPOINTS (fte/faultpoints.py):
+    an armed fault schedule changes what this process will do — an
+    operator reading the log must see it, and a malformed spec must
+    fail LOUDLY at boot instead of silently arming nothing."""
+    spec = os.environ.get("TRINO_TPU_FAULTPOINTS", "").strip()
+    if not spec:
+        return
+    from ..fte.faultpoints import armed_sites, parse_schedule
+    parse_schedule(spec)     # raises ValueError on a malformed spec
+    armed = armed_sites()
+    print("FAULT INJECTION ARMED (TRINO_TPU_FAULTPOINTS): "
+          + ", ".join(f"{site}={action}"
+                      for site, action in sorted(armed.items())),
+          file=sys.stderr)
 
 
 def _worker_main(args, props: Dict[str, str], port: int) -> int:
@@ -238,6 +256,7 @@ def _worker_main(args, props: Dict[str, str], port: int) -> int:
     else:
         print(f"trino-tpu worker {srv.node_id} on {srv.base_uri} "
               "(standalone: pass --coordinator-uri to join a cluster)")
+    _announce_fault_points()
 
     stop = {"flag": False}
 

@@ -616,6 +616,17 @@ class TaskWorkerServer:
                 # /v1/task/{id} -> status (incl. the worker-side
                 # operator stats + span tree for the stage rollup)
                 if len(parts) == 3 and parts[:2] == ["v1", "task"]:
+                    # deterministic chaos site: a raise here turns into
+                    # the 503 a coordinator sees from a worker whose
+                    # status surface is wedged (delay models a stalled
+                    # beat; crash kills the worker process outright)
+                    from ..fte.faultpoints import (FaultInjected,
+                                                   fault_point)
+                    try:
+                        fault_point("worker.pre_status_beat")
+                    except FaultInjected:
+                        self.send_error(503)
+                        return
                     t = worker.get_task(parts[2])
                     if t is None:
                         self.send_error(404)

@@ -50,7 +50,9 @@ from ..fte.retry import (TASK_RETRIES, RetryController, RetryPolicy,
                          backoff_delay, pick_worker)
 from ..fte.speculate import (SPECULATIVE_TASKS, SPECULATIVE_WINS,
                              StragglerDetector)
-from ..obs.metrics import MPP_OVERLAP_RATIO, STAGES_SCHEDULED
+from ..fte.faultpoints import fault_point
+from ..obs.metrics import (FAILOVER_PARTITIONS, MPP_OVERLAP_RATIO,
+                           STAGES_SCHEDULED)
 from ..plan.nodes import PlanNode, TableScanNode
 from .exchange import exchange_task_key
 from .fragmenter import Stage, StageDAG
@@ -133,11 +135,26 @@ class StageExecution:
 
     def __init__(self, scheduler, dag: StageDAG,
                  payloads: Dict[int, dict],
-                 qid: Optional[str] = None):
+                 qid: Optional[str] = None,
+                 ntasks_override: Optional[Dict[int, int]] = None,
+                 resume_spool=None):
         self.s = scheduler              # the owning RemoteScheduler
         self.dag = dag
         self.payloads = payloads
         self.qid = qid or uuid.uuid4().hex[:12]
+        # failover resume (fte/recovery.py ExecutionManifestStore): the
+        # exchange spool's first-commit-wins markers are the durable
+        # progress log, so a resuming coordinator marks every already-
+        # COMMITTED (stage, part) done WITHOUT dispatching it and
+        # replays only the missing partitions. ``resume_spool`` is the
+        # spool the workers committed exchange output to;
+        # ``ntasks_override`` pins the fan-out recorded in the manifest
+        # (the exchange keys embed it — a recomputed fan-out against a
+        # different live-worker count would address different keys).
+        self.resume_spool = resume_spool
+        self._ntasks_override = ntasks_override
+        self.resumed_parts = 0          # committed: served off spool
+        self.replayed_parts = 0         # missing: re-dispatched
         session = scheduler.session
         self.policy = RetryPolicy.from_session(session)
         self.controller = RetryController(self.policy)
@@ -199,6 +216,9 @@ class StageExecution:
                    for i in st.inputs):
                 n = 1
             self.ntasks[st.sid] = max(1, n)
+        if self._ntasks_override:
+            for sid, n in self._ntasks_override.items():
+                self.ntasks[int(sid)] = max(1, int(n))
 
     def _nparts_out(self, stage: Stage) -> int:
         if stage.consumer is None:
@@ -623,7 +643,32 @@ class StageExecution:
                                      args=(st, attempt, wi),
                                      daemon=True).start()
 
-        for st in tasks:
+        pending = tasks
+        if self.resume_spool is not None:
+            # failover resume: a COMMITTED exchange key means some
+            # earlier attempt's output is durable on the spool —
+            # consumers (and the root gather) read it from there, so
+            # the task is done without dispatching anything. Only the
+            # missing partitions are replayed.
+            pending = []
+            for st in tasks:
+                committed = None
+                try:
+                    committed = self.resume_spool.committed_attempt(
+                        st.key, 0, 0)
+                except Exception:   # noqa: BLE001 — treat as missing
+                    pass
+                if committed is not None:
+                    with st.lock:
+                        st.winner = (committed, -1, False)
+                    st.done.set()
+                    self.resumed_parts += 1  # tt-lint: ignore[race-attr-write] driver-thread-only: counted before any task thread launches
+                    FAILOVER_PARTITIONS.inc(outcome="resumed")
+                else:
+                    pending.append(st)
+                    self.replayed_parts += 1  # tt-lint: ignore[race-attr-write] driver-thread-only: counted before any task thread launches
+                    FAILOVER_PARTITIONS.inc(outcome="replayed")
+        for st in pending:
             threading.Thread(target=run_task, args=(st,),
                              daemon=True).start()
         if self.speculation_on:
@@ -644,6 +689,10 @@ class StageExecution:
             raise QueryError(
                 "remote task failed: " + "; ".join(
                     "; ".join(st.errors[-2:]) for st in failed[:3]))
+        # deterministic chaos site: the stage's every partition is now
+        # COMMITTED on the spool — the exact boundary where a crashed
+        # coordinator leaves a resumable, partially-complete query
+        fault_point("coordinator.post_stage_commit")
         if s.collect_stats:
             from ..exec.executor import merge_node_stats
             self.stage_stats[sid] = merge_node_stats(sr.worker_stats)  # tt-lint: ignore[race-attr-write] driver-thread-only, written after the stage's tasks completed
