@@ -887,6 +887,131 @@ def _leg_load_mixed(duration_s: float, clients: int) -> dict:
     })
 
 
+def _leg_storm(duration_s: float, clients: int) -> dict:
+    """Point-query-storm leg (ISSUE 18): K concurrent protocol clients
+    replay Zipf-distributed point lookups against ONE coordinator —
+    the dashboard-storm shape the ragged batch executor
+    (exec/taskexec.py RaggedBatcher + executor._try_ragged_chain) and
+    the coordinator result cache (exec/resultcache.py) exist to serve.
+    Phase A runs with both OFF (every query dispatches and executes
+    alone); phase B turns on ragged_batching + result_cache_enabled —
+    same clients, same Zipf stream, same duration. Reports each
+    phase's client-observed p99, phase B's queries-per-compile
+    (completed / structural jit-cache misses — > 1 means co-batched
+    or cached queries shared a compiled program), and the
+    result-cache hit ratio the Zipf head drove."""
+    import threading
+
+    import trino_tpu  # noqa: F401
+    from trino_tpu.client import ClientError, StatementClient
+    # the real metric objects, not name lookups: resultcache/taskexec
+    # register these families with labels on first import — a bare
+    # METRICS.counter(name) here would register an unlabeled twin
+    from trino_tpu.exec.resultcache import RESULT_CACHE_LOOKUPS as rc
+    from trino_tpu.exec.taskexec import (RAGGED_BATCHES as rb,
+                                         RAGGED_QUERIES as rq)
+    from trino_tpu.obs.metrics import JIT_CACHE_LOOKUPS as jit
+    from trino_tpu.server.coordinator import Coordinator
+
+    KEYS = 256          # distinct point lookups under the Zipf tail
+
+    def sql_for(k: int) -> str:
+        return ("SELECT c_name FROM tpch.tiny.customer "
+                f"WHERE c_custkey = {k}")
+
+    def jit_misses() -> float:
+        # every cache family (chain/stream/masked/ragged) counts: a
+        # compile is a compile wherever it lands
+        return sum(v for k, v in jit.samples() if k and k[-1] == "miss")
+
+    # both phases ride the canonical-key structural path — only the
+    # batching/cache session properties differ between A and B
+    prev = os.environ.get("TRINO_TPU_FRAGMENT_JIT")
+    os.environ["TRINO_TPU_FRAGMENT_JIT"] = "1"
+    co = Coordinator(memory_pool_bytes=4 << 30).start()
+    try:
+        # warm-up: generate tiny tables + pay the parse/plan caches,
+        # split into the leg's compile/warm scoreboard keys
+        warm_client = StatementClient(co.base_uri)
+        cold_s, warm_s = _cold_warm(
+            lambda: warm_client.execute(sql_for(KEYS + 1)), 1)
+
+        def phase(props):
+            lats: list = []
+            lock = threading.Lock()
+            errors = [0]
+            stop_at = time.monotonic() + duration_s
+
+            def run(i: int):
+                c = StatementClient(co.base_uri,
+                                    session_properties=props)
+                rng = np.random.default_rng(1000 + i)
+                mine = []
+                while time.monotonic() < stop_at:
+                    k = min(int(rng.zipf(1.3)), KEYS)
+                    t0 = time.monotonic()
+                    try:
+                        c.execute(sql_for(k))
+                    except (ClientError, OSError):
+                        # transient under churn (admission bounce or a
+                        # connection reset on the threaded HTTP
+                        # stack): counted, never a dead client
+                        errors[0] += 1
+                        continue
+                    mine.append(time.monotonic() - t0)
+                with lock:
+                    lats.extend(mine)
+
+            threads = [threading.Thread(target=run, args=(i,))
+                       for i in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return sorted(lats), errors[0]
+
+        def pct(sorted_xs, q):
+            if not sorted_xs:
+                return 0.0
+            return sorted_xs[min(int(q * len(sorted_xs)),
+                                 len(sorted_xs) - 1)]
+
+        a_lats, a_errs = phase({})
+        m0, h0, l0 = (jit_misses(), rc.value(result="hit"),
+                      sum(v for _, v in rc.samples()))
+        q0, b0 = rq.value(), rb.value()
+        b_lats, b_errs = phase({"ragged_batching": "true",
+                                "result_cache_enabled": "true"})
+        dm = jit_misses() - m0
+        dl = sum(v for _, v in rc.samples()) - l0
+        hits = rc.value(result="hit") - h0
+    finally:
+        co.stop()
+        if prev is None:
+            os.environ.pop("TRINO_TPU_FRAGMENT_JIT", None)
+        else:
+            os.environ["TRINO_TPU_FRAGMENT_JIT"] = prev
+    return dict(_cw_keys(cold_s, warm_s), **{
+        "clients": clients,
+        "duration_s": round(duration_s, 2),
+        "storm_completed": len(a_lats),
+        "storm_batched_completed": len(b_lats),
+        "storm_p99_ms": round(pct(a_lats, 0.99) * 1000, 2),
+        "storm_batched_p99_ms": round(pct(b_lats, 0.99) * 1000, 2),
+        "storm_p50_ms": round(pct(a_lats, 0.50) * 1000, 2),
+        "storm_batched_p50_ms": round(pct(b_lats, 0.50) * 1000, 2),
+        # phase B completions per structural compile: > 1 means the
+        # storm amortized compiles across queries (ragged batches
+        # sharing one program + result-cache hits compiling nothing)
+        "storm_queries_per_compile": round(
+            len(b_lats) / max(dm, 1.0), 2),
+        "result_cache_hit_ratio": round(hits / dl, 4) if dl else 0.0,
+        "ragged_queries": rq.value() - q0,
+        "ragged_batches": rb.value() - b0,
+        "client_errors": a_errs + b_errs,
+    })
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -932,7 +1057,8 @@ def _run_probe_body(kind: str):
                 ("fault", lambda: _leg_fault(2)),
                 ("mpp", lambda: _leg_mpp(2)),
                 ("load", lambda: _leg_load(6.0, 6)),
-                ("load_mixed", lambda: _leg_load_mixed(6.0, 8))]
+                ("load_mixed", lambda: _leg_load_mixed(6.0, 8)),
+                ("storm", lambda: _leg_storm(6.0, 64))]
     for name, fn in legs:
         try:
             # every leg returns a dict carrying (at least) compile_s +
@@ -1018,6 +1144,19 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False,
                       "large_completed", "scheduler_yields"):
                 if k in d:
                     vals[f"load_mixed_{k}"] = d[k]
+        elif leg == "storm" and "storm_p99_ms" in d:
+            # point-query-storm ride-alongs: the ragged-batch +
+            # result-cache scoreboard (ISSUE 18 acceptance keys)
+            vals["storm"] = d["storm_p99_ms"]
+            for k in ("storm_p99_ms", "storm_batched_p99_ms",
+                      "storm_p50_ms", "storm_batched_p50_ms",
+                      "storm_queries_per_compile",
+                      "result_cache_hit_ratio", "storm_completed",
+                      "storm_batched_completed", "ragged_queries",
+                      "ragged_batches"):
+                if k in d:
+                    vals[f"storm_{k}" if not k.startswith("storm")
+                         else k] = d[k]
         elif "qps" in d:
             # load leg ride-alongs: the concurrency scoreboard
             vals["load"] = d["qps"]
@@ -1075,7 +1214,7 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False,
         ("warm",) if kind == "first_compile" else \
         ("engine", "micro", "telemetry") if kind == "steady" else \
         ("engine", "warm", "micro", "telemetry",
-         "fault", "mpp", "load", "load_mixed")
+         "fault", "mpp", "load", "load_mixed", "storm")
     for leg in expected:              # a 0.0 must never be unexplained
         if leg not in vals and leg not in errs:
             errs[leg] = "leg did not complete"
@@ -1403,6 +1542,23 @@ def main():
             or 0.0, 3),
         "load_mixed_scheduler_yields": round(
             cpu_vals.get("load_mixed_scheduler_yields", 0.0) or 0.0, 1),
+        # point-query-storm serving (ISSUE 18: exec/taskexec.py
+        # RaggedBatcher + exec/resultcache.py): K=64 Zipf clients,
+        # phase A per-query dispatch vs phase B ragged batching +
+        # coordinator result cache. Acceptance: batched p99 below
+        # unbatched p99, queries-per-compile > 1, and a non-zero
+        # result-cache hit ratio off the Zipf head
+        "storm_p99_ms": round(
+            cpu_vals.get("storm_p99_ms", 0.0) or 0.0, 2),
+        "storm_batched_p99_ms": round(
+            cpu_vals.get("storm_batched_p99_ms", 0.0) or 0.0, 2),
+        "storm_queries_per_compile": round(
+            cpu_vals.get("storm_queries_per_compile", 0.0) or 0.0, 2),
+        "result_cache_hit_ratio": round(
+            cpu_vals.get("storm_result_cache_hit_ratio", 0.0)
+            or 0.0, 4),
+        "storm_ragged_batches": round(
+            cpu_vals.get("storm_ragged_batches", 0.0) or 0.0, 1),
         "budget_s": BUDGET,
         "elapsed_s": round(time.monotonic() - _T0, 1),
         # BASELINE configs[3] direction: q18 at scale, now through the

@@ -1,0 +1,153 @@
+"""Ragged multi-query batching (exec/taskexec.py RaggedBatcher +
+exec/executor.py _try_ragged_chain): concurrent point lookups that
+co-batch into ONE compiled program must come back row-for-row
+identical to isolated runs — mixed types included (varchar
+dictionaries, Int128 decimals) — and a batch-mate's failure must
+degrade the whole group to solo execution, failing no innocent query.
+"""
+
+import threading
+
+import pytest
+
+import trino_tpu.exec.taskexec as te
+from trino_tpu.runner import LocalQueryRunner
+from trino_tpu.session import Session
+
+# the projection multiplies DECIMAL(12,2) lanes — precision > 18, so
+# the batch carries Int128 (data2) decimal lanes through concat,
+# the ragged program, and the demux gather; s_name rides a dictionary
+SQLS = [
+    ("SELECT s_name, s_acctbal, s_acctbal * s_acctbal AS sq "
+     f"FROM supplier WHERE s_suppkey = {k}")
+    # 9999 matches nothing: the pushed-down scan yields ZERO rows, so
+    # the n<=0 gate runs it solo — it rides along to prove the
+    # empty-result shape stays exact next to a forming batch
+    for k in (3, 17, 42, 58, 9999)
+]
+N_BATCHABLE = 4     # the non-empty point lookups above
+
+
+@pytest.fixture
+def ragged_env(monkeypatch):
+    """A formation window wide enough for plain test threads to meet,
+    and the canonical-chain structural path forced on (the ragged
+    executor only engages on canonicalized chain dispatches)."""
+    monkeypatch.setenv("TRINO_TPU_FRAGMENT_JIT", "1")
+    monkeypatch.setattr(te, "_RAGGED", te.RaggedBatcher(0.5, 1 << 20))
+
+
+def _session(ragged: bool) -> Session:
+    s = Session(catalog="tpch", schema="tiny")
+    if ragged:
+        s.set("ragged_batching", True)
+    return s
+
+
+def _solo_rows():
+    return [LocalQueryRunner(session=_session(False)).execute(sql).rows
+            for sql in SQLS]
+
+
+def _concurrent_rows(ragged: bool = True):
+    """Each query on its own thread through its own runner — the
+    process-global batcher is where they meet."""
+    rows = [None] * len(SQLS)
+    errs = [None] * len(SQLS)
+    batched = [0] * len(SQLS)
+    barrier = threading.Barrier(len(SQLS))
+
+    def run(i):
+        r = LocalQueryRunner(session=_session(ragged))
+        barrier.wait()
+        try:
+            res = r.execute(SQLS[i])
+            rows[i] = res.rows
+            batched[i] = getattr(res, "ragged_batched", 0)
+        except Exception as e:  # noqa: BLE001 — surfaced in asserts
+            errs[i] = e
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(len(SQLS))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return rows, errs, batched
+
+
+def test_cobatched_rows_identical_to_isolated(ragged_env):
+    expected = _solo_rows()
+    assert any(expected), "solo baseline returned nothing"
+    q0 = te.RAGGED_QUERIES.value()
+    b0 = te.RAGGED_BATCHES.value()
+    rows, errs, batched = _concurrent_rows()
+    assert errs == [None] * len(SQLS)
+    # every non-empty member was genuinely served by a ragged batch
+    # (the 0.5s window is orders of magnitude wider than post-barrier
+    # skew) — row-for-row identity of a batch that never formed
+    # proves nothing
+    assert te.RAGGED_QUERIES.value() - q0 == N_BATCHABLE
+    assert te.RAGGED_BATCHES.value() - b0 >= 1
+    assert batched == [1] * N_BATCHABLE + [0]
+    for got, want, sql in zip(rows, expected, SQLS):
+        assert got == want, sql
+
+
+def test_batchmate_failure_leaves_innocents_exact(ragged_env,
+                                                  monkeypatch):
+    """run_group blowing up mid-batch fails NO query: the group
+    publishes no results and every member re-executes solo on its own
+    thread — innocents exact, the fallback counted as an error."""
+    from trino_tpu.exec.executor import Executor
+    expected = _solo_rows()
+
+    def boom(self, key, canon, items):
+        raise RuntimeError("injected ragged group failure")
+
+    monkeypatch.setattr(Executor, "_run_ragged_group", boom)
+    e0 = te.RAGGED_FALLBACKS.value(reason="error")
+    b0 = te.RAGGED_BATCHES.value()
+    rows, errs, _ = _concurrent_rows()
+    assert errs == [None] * len(SQLS)
+    assert rows == expected
+    assert te.RAGGED_BATCHES.value() == b0          # nothing "served"
+    assert te.RAGGED_FALLBACKS.value(reason="error") - e0 >= 1
+
+
+def test_batcher_isolates_offender_to_its_own_thread():
+    """Contract-level isolation: an offender poisoning run_group makes
+    EVERY submit return (False, None) — each caller then runs solo,
+    where only the offender's own retry raises."""
+    batcher = te.RaggedBatcher(window_s=0.3, max_rows=1 << 16)
+    outs = [None] * 3
+    barrier = threading.Barrier(3)
+
+    def run_group(items):
+        if "poison" in items:
+            raise ValueError("offender")
+        return list(items)
+
+    def submit(i, item):
+        barrier.wait()
+        outs[i] = batcher.submit(("sig",), 4, item, run_group)
+
+    threads = [threading.Thread(target=submit, args=(i, item))
+               for i, item in enumerate(["a", "poison", "b"])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert outs == [(False, None)] * 3
+    # the solo re-run: innocents succeed, the offender re-raises
+    assert run_group(["a"]) == ["a"]
+    with pytest.raises(ValueError):
+        run_group(["poison"])
+
+
+def test_oversized_fragment_falls_back_capacity():
+    batcher = te.RaggedBatcher(window_s=0.0, max_rows=64)
+    c0 = te.RAGGED_FALLBACKS.value(reason="capacity")
+    ok, out = batcher.submit(("sig",), 65, "x", lambda items: items)
+    assert (ok, out) == (False, None)
+    assert te.RAGGED_FALLBACKS.value(reason="capacity") - c0 == 1

@@ -25,8 +25,26 @@ def test_property_registry_breadth():
                  "use_table_statistics", "pushdown_into_scan",
                  "multistage_execution", "exchange_partition_count",
                  "prewarm_enabled", "hot_shape_top_k",
-                 "stream_chunk_rows"):
+                 "stream_chunk_rows", "result_cache_enabled",
+                 "ragged_batching", "ragged_batch_max_rows"):
         assert name in SESSION_PROPERTIES, name
+
+
+def test_point_lookup_serving_properties_defaults_and_types():
+    """ISSUE 18 knobs: both serving paths ship OFF by default (opt-in
+    per session — dashboards turn them on), and the batch row cap
+    defaults to the TRINO_TPU_RAGGED_BATCH_ROWS config value."""
+    from trino_tpu.config import CONFIG
+    s = Session()
+    assert s.get("result_cache_enabled") is False
+    assert s.get("ragged_batching") is False
+    assert int(s.get("ragged_batch_max_rows")) == CONFIG.ragged_batch_rows
+    s.set("result_cache_enabled", "true")
+    assert s.get("result_cache_enabled") is True
+    s.set("ragged_batching", "true")
+    assert s.get("ragged_batching") is True
+    s.set("ragged_batch_max_rows", "4096")
+    assert s.get("ragged_batch_max_rows") == 4096
 
 
 def test_stream_chunk_rows_defaults_and_types():

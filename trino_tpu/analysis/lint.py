@@ -284,7 +284,13 @@ _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   # threads and spool commit paths alike; already under
                   # the fte/ prefix, listed explicitly so narrowing
                   # that prefix can never silently drop it
-                  "fte/faultpoints.py")
+                  "fte/faultpoints.py",
+                  # PR 18: the coordinator result cache — query
+                  # threads fill/hit it while the memory-pressure
+                  # ladder (executor eviction, worker status threads)
+                  # sheds it, so its LRU state must stay visible to
+                  # the race detector
+                  "exec/resultcache.py")
 
 
 class _CrossIndex:

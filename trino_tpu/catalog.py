@@ -120,6 +120,17 @@ class Connector:
         (spi/connector/ConnectorPageSource.java:47 getNextPage, batched)."""
         raise NotImplementedError
 
+    # --- data versioning (spi/connector/ConnectorMetadata
+    # getTableHandleForExecute's table-version analog) --------------------
+    def data_version(self) -> Optional[int]:
+        """Monotonic data version for result-cache invalidation
+        (exec/resultcache.py): a cached result is valid only while
+        every scanned connector reports the version it was captured
+        under. None = unversioned (mutations invisible to the engine,
+        e.g. external JDBC sources) — results over it are uncacheable.
+        Immutable pure generators (scan_cache_ok) are constant-1."""
+        return 1 if self.scan_cache_ok else None
+
     # --- statistics (spi/statistics/TableStatistics.java) ----------------
     def table_row_count(self, handle: TableHandle) -> Optional[float]:
         return None

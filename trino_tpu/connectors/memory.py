@@ -23,6 +23,12 @@ class MemoryConnector(Connector):
         self._tables: Dict[Tuple[str, str],
                            Tuple[TableMetadata, List[Batch]]] = {}
         self._schemas = {"default"}
+        # bumped on every mutation: the result cache keys validity on
+        # it, so a cached SELECT goes stale the moment data changes
+        self._version = 1
+
+    def data_version(self) -> Optional[int]:
+        return self._version
 
     def list_schemas(self) -> List[str]:
         return sorted(self._schemas)
@@ -36,6 +42,7 @@ class MemoryConnector(Connector):
 
     def create_schema(self, schema: str) -> None:
         self._schemas.add(schema)
+        self._version += 1
 
     def create_table(self, metadata: TableMetadata) -> None:
         key = (metadata.schema, metadata.name)
@@ -44,14 +51,17 @@ class MemoryConnector(Connector):
                 f"Table '{metadata.schema}.{metadata.name}' already exists")
         self._schemas.add(metadata.schema)
         self._tables[key] = (metadata, [])
+        self._version += 1
 
     def drop_table(self, schema: str, table: str) -> None:
         self._tables.pop((schema, table), None)
+        self._version += 1
 
     def insert(self, schema: str, table: str, batch: Batch) -> int:
         meta, batches = self._tables[(schema, table)]
         batch = batch.rename(dict(zip(batch.names, meta.column_names)))
         batches.append(batch)
+        self._version += 1
         return batch.num_rows_host()
 
     def replace(self, schema: str, table: str, batch: Batch) -> None:
@@ -59,6 +69,7 @@ class MemoryConnector(Connector):
         meta, _ = self._tables[(schema, table)]
         batch = batch.rename(dict(zip(batch.names, meta.column_names)))
         self._tables[(schema, table)] = (meta, [batch])
+        self._version += 1
 
     def read_split(self, split: Split, columns: Sequence[str]) -> Batch:
         meta, batches = self._tables[(split.handle.schema,
@@ -101,6 +112,7 @@ class MemoryConnector(Connector):
         self._tables = {k: (meta, list(batches))
                         for k, (meta, batches) in tables.items()}
         self._schemas = set(schemas)
+        self._version += 1
 
 
 class BlackholeConnector(Connector):

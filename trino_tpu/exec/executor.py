@@ -250,6 +250,11 @@ _STREAM_JIT_CACHE: Dict[tuple, object] = {}
 _STREAM_JIT_DENY: set = set()
 _CHAIN_JIT_CACHE: Dict[tuple, object] = {}
 _CHAIN_JIT_DENY: set = set()
+# ragged multi-query batch programs (canonical chain + the __rq
+# provenance lane threaded through, exec/progkey.py ragged_nodes):
+# keyed on the canonical chain key — jax specializes per combined
+# capacity under one callable, same as the solo chain cache
+_RAGGED_JIT_CACHE: Dict[tuple, object] = {}
 # window programs (execute_window over one canonical WindowNode) and
 # the two-phase materialized hash-join programs (count + expand over
 # ops/join.py) — the "window" and "join" AOT kinds of exec/aot.py
@@ -376,6 +381,11 @@ class Executor:
         # and rolled up by the remote/stage schedulers
         self.stream_chunks: int = 0
         self.stream_h2d_bytes: int = 0
+        # ragged multi-query batching (exec/taskexec.py RaggedBatcher):
+        # chain dispatches this query served through a co-batched
+        # ragged program — exported in worker task status
+        # (raggedBatched) and rolled up by the remote/stage schedulers
+        self.ragged_batched: int = 0
         # device-time attribution (ISSUE 15): seconds this executor's
         # jitted dispatches spent to data-ready (_jit_call block-until-
         # ready deltas), exported as deviceSeconds in worker task
@@ -1026,10 +1036,144 @@ class Executor:
             binding = canon.binding(base)
             cb = binding.rename_in(base)
             from .hotshapes import record_program
+            # record the SOLO canonical program: the hot shape the
+            # fleet pre-warms is the chain itself, not the ragged
+            # variant (whose capacity depends on who co-arrives)
             record_program("chain", key, canon, cb, self.session)
-            out = self._jit_call(jitted, (cb,), "chain", hit)
+            out = self._try_ragged_chain(key, canon, cb)
+            if out is None:
+                out = self._jit_call(jitted, (cb,), "chain", hit)
             return binding.rename_out(out)
         return self._jit_call(jitted, (base,), "chain", hit)
+
+    # ------------------------------------------------------------------
+    # ragged multi-query batching (tentpole, ISSUE 18): compatible
+    # small canonical fragments from CONCURRENT queries coalesce into
+    # one combined batch run by a single compiled program, with a
+    # per-row provenance lane (__rq) demuxing result rows back to each
+    # owning query. Telemetry stays per-query: each participant records
+    # its own ragged_batch trace span and bumps its own counter; the
+    # leader's executor carries the batch's device seconds and memory
+    # reservation (an over-budget batch fails formation for everyone,
+    # who then run solo under their own budgets).
+    # ------------------------------------------------------------------
+    def _try_ragged_chain(self, key: tuple, canon, cb: Batch
+                          ) -> Optional[Batch]:
+        """Offer a canonical chain dispatch for co-batching. Returns
+        this query's demuxed output (canonical names — the caller's
+        binding renames out), or None to run solo."""
+        session = self.session
+        try:
+            if not bool(session.get("ragged_batching")):
+                return None
+            max_rows = int(session.get("ragged_batch_max_rows")) \
+                or CONFIG.ragged_batch_rows
+        except (KeyError, TypeError, ValueError):
+            return None
+        # only pure Filter/Project chains batch: Limit/Sort/TopN/
+        # Sample/MarkDistinct have per-query cross-row semantics that
+        # break under concatenation
+        if not canon.nodes or not all(
+                isinstance(nd, (FilterNode, ProjectNode))
+                for nd in canon.nodes):
+            return None
+        n = cb.num_rows
+        if not isinstance(n, int):
+            return None     # device-resident count: syncing to form
+            #                 a batch would stall the async pipeline
+        # leave room for at least one batch-mate
+        if n <= 0 or n * 2 > max_rows:
+            return None
+        if any(c.elements is not None or c.children is not None
+               for c in cb.columns.values()):
+            return None     # array/map/row lanes: concat delegates to
+            #                 host-side complex merge — not worth it
+        # compatibility signature: canonical program + column layout
+        # (same canonical key from DIFFERENT tables can carry different
+        # types) + catalog (one connector per batch)
+        sig = (key, session.catalog,
+               tuple((name, repr(c.type))
+                     for name, c in cb.columns.items()))
+        from .taskexec import ragged_batcher
+
+        def run_group(items):
+            return self._run_ragged_group(key, canon, items)
+
+        t0 = time.perf_counter()
+        ok, out = ragged_batcher().submit(
+            sig, n, cb, run_group,
+            wait=getattr(session, "slot_wait", None),
+            max_rows=max_rows)
+        if not ok:
+            return None
+        self.ragged_batched += 1
+        tr = self.trace
+        if tr is not None:
+            tr.record("ragged_batch", t0, time.perf_counter(),
+                      rows=n)
+        return out
+
+    def _run_ragged_group(self, key: tuple, canon,
+                          items: List[Batch]) -> List[Batch]:
+        """Leader-side group execution: combine members' canonical
+        batches (+ provenance lane), run ONE compiled ragged program,
+        demux rows back per member by lane value."""
+        import numpy as np
+        from ..columnar import Column, concat_batches
+        from ..types import BIGINT
+        from .progkey import RAGGED_LANE, ragged_nodes
+        ns = [b.num_rows_host() for b in items]
+        total = sum(ns)
+        combined = concat_batches(items)
+        cap = combined.capacity
+        # reserve-before-allocate on the LEADER (the thread that
+        # executes): a batch the leader's query cannot afford fails
+        # formation — every member then runs solo under its own budget
+        self._reserve(cap, len(combined.columns) + 1, "ragged batch")
+        lane = np.concatenate([
+            np.repeat(np.arange(len(items), dtype=np.int64),
+                      np.asarray(ns, dtype=np.int64)),
+            # padding rows carry the sentinel len(items): no member's
+            # demux selector can ever match them
+            np.full(cap - total, len(items), dtype=np.int64)])
+        ragged = Batch(
+            {**combined.columns,
+             RAGGED_LANE: Column(BIGINT, jnp.asarray(lane))}, total)
+        rkey = ("ragged",) + tuple(key)
+        jitted = _RAGGED_JIT_CACHE.get(rkey)
+        hit = jitted is not None
+        _M_JIT.inc(cache="ragged", result="hit" if hit else "miss")
+        if jitted is None:
+            helper = self._detached()
+            nodes = ragged_nodes(canon.nodes)
+
+            def fn(b):
+                for nd in reversed(nodes):
+                    b = helper._dispatch_apply(nd, b)
+                return b
+            jitted = jax.jit(fn)
+            _cache_put(_RAGGED_JIT_CACHE, rkey, jitted)
+        out = self._jit_call(jitted, (ragged,), "ragged", hit)
+        # demux: ONE host sync for the lane, then a per-member row
+        # gather (the engine's own compaction primitive — dictionaries,
+        # Int128 lanes and validity all route through Column.gather).
+        # filter compaction is STABLE (mask_to_gather's nonzero is
+        # ascending) and members' input rows are contiguous, so each
+        # member's relative row order matches its solo run exactly.
+        n_out = out.num_rows_host()
+        lane_out = np.asarray(
+            jax.device_get(out.column(RAGGED_LANE).data))[:n_out]
+        bare = Batch({k: c for k, c in out.columns.items()
+                      if k != RAGGED_LANE}, out.num_rows)
+        results = []
+        for i in range(len(items)):
+            sel = np.nonzero(lane_out == i)[0]
+            k = len(sel)
+            cap_i = capacity_for(k, minimum=8)
+            idx = np.zeros(cap_i, dtype=np.int64)
+            idx[:k] = sel
+            results.append(bare.gather(jnp.asarray(idx), k))
+        return results
 
     # ------------------------------------------------------------------
     # leaves
@@ -2175,8 +2319,16 @@ def cache_memory_bytes() -> int:
     table lanes share the same device/host memory as query working
     sets, so a pool sized to the hardware must see them."""
     with _SCAN_CACHE_LOCK:
-        return sum(int(state["bytes"])
+        scan = sum(int(state["bytes"])
                    for state in _SCAN_CACHES.values())
+    # the result cache holds host-side rows, not HBM lanes, but it is
+    # process memory the pressure ladder can shed — governance must
+    # see it or it silently erodes the pool headroom
+    try:
+        from .resultcache import RESULT_CACHE
+        return scan + RESULT_CACHE.bytes()
+    except Exception:       # noqa: BLE001 — import cycles in teardown
+        return scan
 
 
 from ..obs.metrics import CACHE_PRESSURE_EVICTS as _M_CACHE_PRESSURE
@@ -2220,8 +2372,21 @@ def evict_cache_pressure(need_bytes: int) -> int:
         except Exception:       # noqa: BLE001 — relief is best-effort
             pass
     if freed < need:
+        # the result cache sheds BEFORE the jit caches: cached rows
+        # are merely saved latency, compiled programs are saved
+        # compile storms — drop the cheaper-to-rebuild tier first
+        try:
+            from .resultcache import RESULT_CACHE
+            before = len(RESULT_CACHE)
+            freed += RESULT_CACHE.evict(need - freed)
+            for _ in range(before - len(RESULT_CACHE)):
+                _M_CACHE_PRESSURE.inc(cache="result")
+        except Exception:   # noqa: BLE001 — relief is best-effort
+            pass
+    if freed < need:
         with _JIT_CACHE_LOCK:
-            for cache in (_CHAIN_JIT_CACHE, _STREAM_JIT_CACHE):
+            for cache in (_CHAIN_JIT_CACHE, _STREAM_JIT_CACHE,
+                          _RAGGED_JIT_CACHE):
                 for _ in range(len(cache) // 2):
                     try:
                         cache.pop(next(iter(cache)))

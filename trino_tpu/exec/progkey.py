@@ -277,6 +277,34 @@ class CanonicalProgram:
         return to_jsonable(body)
 
 
+# ---- ragged multi-query batching (exec/taskexec.py RaggedBatcher +
+# exec/executor.py _try_ragged_chain) ---------------------------------
+
+# per-row provenance lane of a ragged batch: which co-batched query
+# (by part index) owns the row. Prefixed so it can never collide with
+# a canonical (c<i>) or extension (x<i>) symbol.
+RAGGED_LANE = "__rq"
+
+
+def ragged_nodes(nodes_top_down: Sequence[PlanNode]) -> List[PlanNode]:
+    """Thread the provenance lane through a canonical chain: the lane
+    column rides every FilterNode for free (filter_batch gathers ALL
+    columns), but a ProjectNode drops unreferenced columns — so each
+    one re-emits the lane as a pass-through assignment. Callers gate
+    batchability to Filter/Project chains (Limit/Sort/TopN/Sample have
+    per-query cross-row semantics that break under concatenation)."""
+    from ..types import BIGINT
+    out: List[PlanNode] = []
+    for nd in nodes_top_down:
+        if isinstance(nd, ProjectNode):
+            out.append(dc_replace(nd, assignments={
+                **nd.assignments,
+                RAGGED_LANE: InputRef(RAGGED_LANE, BIGINT)}))
+        else:
+            out.append(nd)
+    return out
+
+
 def peel_wire_fragment(root: PlanNode) -> Tuple[List[PlanNode], Dict]:
     """Inverse of ``wire_fragment``: (top-down node stack, input
     schema) from a decoded fragment."""

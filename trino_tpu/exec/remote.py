@@ -153,6 +153,9 @@ class RemoteScheduler:
         # rollup and query-wide for the result
         self.cpu_seconds = 0.0
         self.device_seconds = 0.0
+        # ragged batching: chain dispatches this query's tasks served
+        # through co-batched programs (worker status raggedBatched)
+        self.ragged_batched = 0
         self.fragment_cpu: Dict[int, float] = {}
         self.fragment_device: Dict[int, float] = {}
         # fault-tolerant execution (trino_tpu/fte/): the heartbeat
@@ -924,6 +927,8 @@ class RemoteScheduler:
                             status.get("streamH2dBytes") or 0)
                         self.cpu_seconds += cpu_s
                         self.device_seconds += dev_s
+                        self.ragged_batched += int(
+                            status.get("raggedBatched") or 0)
                         self.fragment_cpu[f.fid] = \
                             self.fragment_cpu.get(f.fid, 0.0) + cpu_s
                         self.fragment_device[f.fid] = \
@@ -1423,6 +1428,7 @@ class DistributedHostQueryRunner:
         res.stream_h2d_bytes = sched.stream_h2d_bytes
         res.cpu_seconds = sched.cpu_seconds
         res.device_seconds = sched.device_seconds
+        res.ragged_batched = sched.ragged_batched
         if self.collect_node_stats:
             res.stats = sched.stats
         return res
@@ -1491,6 +1497,7 @@ class DistributedHostQueryRunner:
         res.stream_h2d_bytes = sched.stream_h2d_bytes
         res.cpu_seconds = sched.cpu_seconds
         res.device_seconds = sched.device_seconds
+        res.ragged_batched = sched.ragged_batched
         return res
 
 

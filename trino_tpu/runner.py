@@ -395,6 +395,7 @@ class LocalQueryRunner:
         result.plan_lines = plan_tree_lines(plan)
         result.peak_memory_bytes = getattr(ex, "peak_reserved_bytes", 0)
         result.spill_bytes = getattr(ex, "spilled_bytes", 0)
+        result.ragged_batched = getattr(ex, "ragged_batched", 0)
         if collect_stats:
             result.stats = ex.stats
         return result

@@ -603,6 +603,15 @@ class Coordinator:
         self._catalogs.register("system", SystemConnector(self))
 
         def make_runner(session: Session):
+            # result cache (exec/resultcache.py): wraps BOTH runner
+            # kinds — a hit on a repeated identical deterministic
+            # query returns before any planning/dispatch below
+            from ..exec.resultcache import CachingQueryRunner
+
+            def wrap(runner):
+                return CachingQueryRunner(runner, session,
+                                          self._catalogs)
+
             live = self.live_workers()
             if live:
                 from ..exec.remote import DistributedHostQueryRunner
@@ -637,7 +646,7 @@ class Coordinator:
                             "submitEpoch": tq.created,
                             "startedEpoch": tq.started,
                         }
-                return DistributedHostQueryRunner(
+                return wrap(DistributedHostQueryRunner(
                     live, session=session, catalogs=self._catalogs,
                     collect_node_stats=True,
                     failure_detector=self.failure_detector,
@@ -647,13 +656,13 @@ class Coordinator:
                     # live membership: mid-query joins become retry /
                     # speculation targets (exec/remote.py syncs this
                     # before every replacement dispatch)
-                    worker_supplier=self.live_workers)
+                    worker_supplier=self.live_workers))
             # per-node wall/row stats feed the web UI's query detail
             # (OperatorStats is always-on in the reference coordinator)
-            return LocalQueryRunner(session=session,
-                                    catalogs=self._catalogs,
-                                    mesh=self._proto.mesh,
-                                    collect_node_stats=True)
+            return wrap(LocalQueryRunner(session=session,
+                                         catalogs=self._catalogs,
+                                         mesh=self._proto.mesh,
+                                         collect_node_stats=True))
 
         events = EventListenerManager()
         for listener in (event_listeners or []):

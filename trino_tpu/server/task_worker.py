@@ -183,6 +183,10 @@ class _Task:
         # trace and the EXPLAIN ANALYZE stage rollup
         self.cpu_seconds = 0.0
         self.device_seconds = 0.0
+        # ragged batching (exec/taskexec.py RaggedBatcher): chain
+        # dispatches this task served through a co-batched program —
+        # rolled up per query by the schedulers
+        self.ragged_batched = 0
         # distributed tracing: the query's 128-bit trace id this
         # task's spans were born with (from the traceparent the
         # payload carried); None when the task was untraced
@@ -223,6 +227,12 @@ class _Task:
                     weight=float(payload.get("group_weight") or 1.0),
                     cancel=self.cancel_ev)
                 session.split_yield = handle.checkpoint
+                # ragged batch formation (exec/taskexec.py
+                # RaggedBatcher): both the leader's window sleep and a
+                # member's result wait release the runner slot —
+                # members holding every slot would deadlock the
+                # leader's re-acquire
+                session.slot_wait = handle.run_blocked
             # live memory accounting: the executor's reservations land
             # on this task (status beats carry them to the
             # coordinator's pool) and arm worker-local cache relief
@@ -325,6 +335,7 @@ class _Task:
                 self.stream_chunks = ex.stream_chunks  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.stream_h2d_bytes = ex.stream_h2d_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.device_seconds = ex.device_s  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                self.ragged_batched = ex.ragged_batched  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             else:
                 runner = LocalQueryRunner(session=session,
                                           catalogs=self.catalogs)
@@ -646,6 +657,7 @@ class TaskWorkerServer:
                          "streamH2dBytes": t.stream_h2d_bytes,
                          "cpuSeconds": t.cpu_seconds,
                          "deviceSeconds": t.device_seconds,
+                         "raggedBatched": t.ragged_batched,
                          "traceId": t.trace_id}).encode()
                     self.send_response(200)
                     self.send_header("Content-Type", "application/json")

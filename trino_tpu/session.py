@@ -156,6 +156,24 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # + completion-time peaks (the pre-PR-14 behavior; the escape
     # hatch for tests pinning killer provenance).
     "live_memory_feedback": (bool, True),
+    # ---- point-lookup serving (exec/resultcache.py +
+    # exec/taskexec.py RaggedBatcher) ----------------------------------
+    # serve a repeated identical deterministic query straight from the
+    # coordinator's result cache (canonical program key + split
+    # fingerprint, invalidated by connector data version) with zero
+    # dispatched tasks. Opt-in: a cached result is synthesized without
+    # plan/trace/stats, so interactive EXPLAIN ANALYZE-style workflows
+    # keep the default off (dashboards SET it on).
+    "result_cache_enabled": (bool, False),
+    # coalesce compatible small fragments (same canonical program key,
+    # same connector, combined rows under ragged_batch_max_rows) into
+    # ONE ragged batch executed by a single compiled program, demuxed
+    # per query. Opt-in: the formation window adds latency to solo
+    # queries, so only storm-shaped workloads should enable it.
+    "ragged_batching": (bool, False),
+    # combined-row cap for one ragged batch (the batch-capacity
+    # bucket); fragments whose sum would exceed it run solo
+    "ragged_batch_max_rows": (int, CONFIG.ragged_batch_rows),
     # ---- distributed tracing (obs/trace.py + obs/otlp.py) ------------
     # export this query's finished trace to the configured OTLP sinks
     # (TRINO_TPU_OTLP_FILE / TRINO_TPU_OTLP_ENDPOINT). Off = the trace
@@ -209,6 +227,13 @@ class Session:
     # so concurrent queries' tasks interleave on the shared runner
     # pool; None outside a scheduled worker task
     split_yield: Optional[object] = None
+    # slot-releasing wait hook (exec/taskexec.py TaskHandle.run_blocked,
+    # installed next to split_yield): ragged batch formation parks the
+    # leader for the window and members for the leader's execution —
+    # both waits MUST release the bounded runner slot or members
+    # holding every slot deadlock the leader's re-acquire; None = wait
+    # inline (standalone runner, no pool to starve)
+    slot_wait: Optional[object] = None
 
     def remaining_time(self) -> Optional[float]:
         """Seconds left before the deadline (None = no deadline).

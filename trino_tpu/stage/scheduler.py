@@ -471,6 +471,8 @@ class StageExecution:
                         status.get("streamH2dBytes") or 0)
                     s.cpu_seconds += cpu_s
                     s.device_seconds += dev_s
+                    s.ragged_batched += int(
+                        status.get("raggedBatched") or 0)
                     self.stage_cpu[sid] = \
                         self.stage_cpu.get(sid, 0.0) + cpu_s
                     self.stage_device[sid] = \
