@@ -344,19 +344,26 @@ def _leg_telemetry(schema: str, iters: int) -> dict:
     """Fractional overhead of telemetry on the DEFAULT (multistage
     MPP) distributed path: TPC-H q1 through two in-process workers
     with collect_node_stats OFF vs ON — ON meaning the full PR 15
-    stack: distributed tracing (traceparent propagation, id-preserving
-    span merge), device/CPU attribution, AND OTLP file export. The
-    always-on OperatorStats question — this ratio is what decides
-    whether telemetry can default on; target < 0.05
-    (tests/test_observability.py). ``overhead`` is a fraction (0.03 =
-    3% slower); the compile/warm split rides along from the
-    telemetry-off run."""
+    stack (distributed tracing with traceparent propagation and
+    id-preserving span merge, device/CPU attribution, OTLP file
+    export) PLUS the PR 19 ride-alongs: learned operator statistics
+    (worker ``learnedStats`` deltas merged at the scheduler,
+    exec/learnedstats.py) and a query-history record append per run
+    (obs/history.py). The always-on OperatorStats question — this
+    ratio is what decides whether telemetry can default on; target
+    < 0.05 (tests/test_observability.py). ``overhead`` is a fraction
+    (0.03 = 3% slower); the compile/warm split rides along from the
+    telemetry-off run. Each bench round also appends its own summary
+    record to the DEFAULT history store, so the perf trajectory
+    itself is queryable via system.runtime.queries."""
     import tempfile
 
     import trino_tpu  # noqa: F401
     from trino_tpu.benchmarks.tpch_queries import TPCH_QUERIES
     from trino_tpu.config import CONFIG
+    from trino_tpu.exec.learnedstats import LEARNED_STATS
     from trino_tpu.exec.remote import DistributedHostQueryRunner
+    from trino_tpu.obs.history import QueryHistoryStore, sql_digest
     from trino_tpu.server.task_worker import TaskWorkerServer
     from trino_tpu.session import Session
 
@@ -364,18 +371,39 @@ def _leg_telemetry(schema: str, iters: int) -> dict:
     uris = [w.base_uri for w in workers]
     sink = os.path.join(tempfile.mkdtemp(prefix="bench_otlp_"),
                         "traces.jsonl")
+    hist = QueryHistoryStore(os.path.join(
+        CONFIG.spool_dir, "history", "queries.jsonl"))
     old_file = CONFIG.otlp_file
+    lstats0 = len(LEARNED_STATS)
+    plan_key = ""
     try:
         def cold_best(collect: bool):
-            # OTLP export rides ONLY the telemetry-on side: the
-            # overhead number prices tracing + attribution + export
-            # together, against a genuinely dark baseline
+            # OTLP export + history append ride ONLY the telemetry-on
+            # side: the overhead number prices tracing + attribution +
+            # export + history + learned stats together, against a
+            # genuinely dark baseline
             CONFIG.otlp_file = sink if collect else ""
             r = DistributedHostQueryRunner(
                 uris, session=Session(catalog="tpch", schema=schema),
                 collect_node_stats=collect)
-            return _cold_warm(lambda: r.execute(TPCH_QUERIES[1]),
-                              iters)
+
+            def once():
+                res = r.execute(TPCH_QUERIES[1])
+                if collect:
+                    nonlocal plan_key
+                    plan_key = getattr(res, "plan_key", "") or plan_key
+                    hist.record({
+                        "query_id": "bench_telemetry_"
+                                    + time.strftime("%Y%m%d_%H%M%S"),
+                        "state": "FINISHED", "user": "bench",
+                        "source": "bench", "sql": TPCH_QUERIES[1][:512],
+                        "sql_digest": sql_digest(TPCH_QUERIES[1]),
+                        "plan_key": plan_key,
+                        "wall_s": 0.0, "rows": len(res.rows),
+                        "cpu_s": getattr(res, "cpu_seconds", 0.0),
+                        "created": time.time()})
+
+            return _cold_warm(once, iters)
 
         off_cold, off = cold_best(False)
         _, on = cold_best(True)
@@ -388,8 +416,19 @@ def _leg_telemetry(schema: str, iters: int) -> dict:
         CONFIG.otlp_file = old_file
         for w in workers:
             w.stop()
+    # the leg's own verdict record: one summary per bench round, the
+    # overhead trajectory queryable as source='bench' history rows
+    hist.record({
+        "query_id": "bench_round_" + time.strftime("%Y%m%d_%H%M%S"),
+        "state": "FINISHED", "user": "bench", "source": "bench",
+        "sql": "-- bench telemetry leg summary",
+        "sql_digest": sql_digest("-- bench telemetry leg summary"),
+        "plan_key": plan_key, "wall_s": on, "created": time.time(),
+        "bench_overhead": max(on / off - 1.0, 0.0)})
     return dict({"overhead": max(on / off - 1.0, 0.0),
-                 "otlp_exports": exports},
+                 "otlp_exports": exports,
+                 "learned_entries": len(LEARNED_STATS) - lstats0,
+                 "history_records": len(hist)},
                 **_cw_keys(off_cold, off))
 
 

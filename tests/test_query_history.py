@@ -1,0 +1,488 @@
+"""Query history & learned operator statistics (PR 19): the engine
+observes itself with SQL.
+
+Covers the tentpole end to end — the bounded/TTL'd/JSONL-durable
+query-history store (obs/history.py), the learned-stats registry with
+hot-shape-style origin-deduped delta transport (exec/learnedstats.py),
+the /v1/history, /v1/stats and bare /v1/trace endpoints, and the
+system.runtime.{queries,operator_stats,metrics} tables scanned through
+the default MPP path — plus the failure-path records satellite: an
+OOM kill, a deadline breach and a queue-full rejection each land
+exactly one classified record with non-zero timing."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from trino_tpu.client import ClientError, StatementClient
+from trino_tpu.exec.learnedstats import (LEARNED_STATS,
+                                         LearnedStatsRegistry,
+                                         record_node_stats)
+from trino_tpu.obs.history import (QueryHistoryStore, TraceRing,
+                                   record_from_query, sql_digest)
+from trino_tpu.runner import LocalQueryRunner, QueryResult
+from trino_tpu.server.coordinator import Coordinator, QueryTracker
+from trino_tpu.session import Session
+
+
+def _wait_until(pred, timeout=10.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def _get_json(uri):
+    with urllib.request.urlopen(uri, timeout=10) as resp:
+        return json.load(resp)
+
+
+# --- learned-stats registry (exec/learnedstats.py) -------------------------
+
+def test_learned_stats_ema_selectivity_and_rate():
+    reg = LearnedStatsRegistry(capacity=8, alpha=0.5)
+    reg.observe("k1", "Filter", 0, rows_in=100, rows_out=50, wall_s=0.5)
+    ent = reg.lookup("k1", "Filter", 0)
+    assert ent["selectivity"] == pytest.approx(0.5)
+    assert ent["rows_per_s"] == pytest.approx(100.0)
+    # EMA folds the second observation at alpha=0.5
+    reg.observe("k1", "Filter", 0, rows_in=100, rows_out=100, wall_s=0.5)
+    ent = reg.lookup("k1", "Filter", 0)
+    assert ent["selectivity"] == pytest.approx(0.75)
+    assert ent["n"] == 2 and ent["rows_in"] == 200
+    # unknown rows (-1) must not poison the EMAs
+    reg.observe("k1", "Filter", 0, rows_in=-1, rows_out=-1, wall_s=0.1)
+    assert reg.lookup("k1", "Filter", 0)["selectivity"] \
+        == pytest.approx(0.75)
+    # occurrence index separates repeated operator names
+    reg.observe("k1", "Filter", 1, rows_in=10, rows_out=1, wall_s=0.1)
+    assert reg.lookup("k1", "Filter", 1)["selectivity"] \
+        == pytest.approx(0.1)
+
+
+def test_learned_stats_lru_capacity():
+    reg = LearnedStatsRegistry(capacity=2, alpha=0.5)
+    for i in range(4):
+        reg.observe(f"k{i}", "Scan", 0, 10, 10, 0.1)
+    assert len(reg) == 2
+    assert reg.lookup("k0", "Scan", 0) is None
+    assert reg.lookup("k3", "Scan", 0) is not None
+
+
+def test_learned_stats_merge_dedups_self_origin():
+    """The hot-shape transport contract: a registry never re-absorbs
+    its own exported observations (shared-process worker), merges
+    foreign ones, and a relay re-export preserves the ORIGINAL origin
+    so the true source still dedups."""
+    a = LearnedStatsRegistry(capacity=8)
+    b = LearnedStatsRegistry(capacity=8)
+    before = a.seq()
+    a.observe("k", "Join", 0, 100, 20, 0.2)
+    delta = a.export_delta(before)
+    assert len(delta) == 1 and delta[0]["origin"] == a.origin
+    # self-merge: dropped entirely
+    assert a.merge(delta) == 0 and a.lookup("k", "Join", 0)["n"] == 1
+    # foreign merge: absorbed with b's own smoothing
+    b_before = b.seq()
+    assert b.merge(delta) == 1
+    assert b.lookup("k", "Join", 0)["selectivity"] == pytest.approx(0.2)
+    # relay: b re-exports what it merged; a still recognizes itself
+    relayed = b.export_delta(b_before)
+    assert relayed[0]["origin"] == a.origin
+    assert a.merge(relayed) == 0
+    assert a.lookup("k", "Join", 0)["n"] == 1
+
+
+def test_learned_stats_save_load_roundtrip(tmp_path):
+    reg = LearnedStatsRegistry(capacity=8)
+    reg.observe("k", "Scan", 0, 1000, 500, 1.0)
+    path = str(tmp_path / "learned_stats.json")
+    assert reg.save(path)
+    fresh = LearnedStatsRegistry(capacity=8)
+    assert fresh.load(path) == 1
+    ent = fresh.lookup("k", "Scan", 0)
+    assert ent["selectivity"] == pytest.approx(0.5)
+    assert ent["rows_per_s"] == pytest.approx(500.0)
+    # live entries win over persisted ones on load
+    fresh.observe("k2", "Scan", 0, 10, 1, 0.1)
+    assert fresh.load(path) == 0      # k already live, nothing new
+    assert len(fresh) == 2
+
+
+def test_record_node_stats_respects_session_gate():
+    reg_len = len(LEARNED_STATS)
+    s = Session()
+    s.set("learned_stats_enabled", False)
+    r = QueryResult(["c"], [], [[1]])
+    stats = [{"name": "Output", "input_rows": 5, "output_rows": 5,
+              "wall_s": 0.01}]
+    assert record_node_stats("gatedkey", stats, s) == 0
+    assert len(LEARNED_STATS) == reg_len
+    s.set("learned_stats_enabled", True)
+    assert record_node_stats("gatedkey", stats, s) == 1
+    assert LEARNED_STATS.lookup("gatedkey", "Output", 0) is not None
+
+
+def test_plan_key_stable_and_distinct():
+    """Every executed plan gets a non-empty deterministic key; the
+    same SQL re-keys identically, different programs differ."""
+    r = LocalQueryRunner(collect_node_stats=True)
+    k1 = r.execute("SELECT count(*) FROM tpch.tiny.nation").plan_key
+    k2 = r.execute("SELECT count(*) FROM tpch.tiny.nation").plan_key
+    k3 = r.execute("SELECT count(*) FROM tpch.tiny.region").plan_key
+    assert k1 and k1 == k2
+    assert k3 and k3 != k1
+
+
+# --- history store (obs/history.py) ----------------------------------------
+
+def _fake_record(i, state="FINISHED", created=None):
+    return {"query_id": f"q{i}", "state": state, "sql": f"SELECT {i}",
+            "sql_digest": sql_digest(f"SELECT {i}"), "wall_s": 0.1,
+            "created": created if created is not None else time.time()}
+
+
+def test_history_store_bounded_and_jsonl_durable(tmp_path):
+    path = str(tmp_path / "queries.jsonl")
+    store = QueryHistoryStore(path, capacity=4, ttl_s=3600)
+    for i in range(10):
+        store.record(_fake_record(i))
+    assert len(store) == 4
+    recs = store.records()
+    assert [r["query_id"] for r in recs] == ["q9", "q8", "q7", "q6"]
+    assert store.get("q9") is not None and store.get("q0") is None
+    # state filter + limit
+    store.record(_fake_record(99, state="FAILED"))
+    assert [r["query_id"] for r in store.records(state="FAILED")] \
+        == ["q99"]
+    assert len(store.records(limit=2)) == 2
+    # a NEW store over the same file reloads the survivors
+    again = QueryHistoryStore(path, capacity=4, ttl_s=3600)
+    assert {r["query_id"] for r in again.records()} \
+        == {"q99", "q9", "q8", "q7"}
+
+
+def test_history_store_ttl_prunes(tmp_path):
+    store = QueryHistoryStore(str(tmp_path / "q.jsonl"), capacity=8,
+                              ttl_s=60)
+    old = _fake_record(0, created=time.time() - 3600)
+    old["recorded_at"] = time.time() - 3600
+    store._records.append(old)        # pre-aged entry
+    store.record(_fake_record(1))
+    assert [r["query_id"] for r in store.records()] == ["q1"]
+    # reload path drops expired lines too
+    store2 = QueryHistoryStore(str(tmp_path / "q2.jsonl"), capacity=8,
+                               ttl_s=60)
+    store2.record(dict(_fake_record(2),
+                       recorded_at=time.time() - 3600))
+    assert QueryHistoryStore(store2.path, capacity=8,
+                             ttl_s=60).records() == []
+
+
+def test_slow_query_log_side_channel(tmp_path):
+    store = QueryHistoryStore(str(tmp_path / "queries.jsonl"))
+    rec = store.record(_fake_record(1))
+    store.slow_log(rec, 50)
+    lines = (tmp_path / "slow_queries.jsonl").read_text().splitlines()
+    entry = json.loads(lines[-1])
+    assert entry["query_id"] == "q1"
+    assert entry["slow_query_threshold_ms"] == 50
+
+
+def test_trace_ring_bounded_and_traceless_noop():
+    ring = TraceRing(capacity=2)
+    ring.append("q0", "FINISHED", None)         # traceless: no entry
+    assert len(ring) == 0
+
+    class _Span:
+        def __init__(self, name):
+            self.name, self.wall_s, self.children = name, 0.5, []
+
+    class _Trace:
+        def __init__(self, tid):
+            self.trace_id = tid
+            self.roots = [_Span("query")]
+
+    for i in range(3):
+        ring.append(f"q{i}", "FINISHED", _Trace(f"t{i}"))
+    out = ring.list()
+    assert [e["traceId"] for e in out] == ["t2", "t1"]
+    assert out[0]["rootSpans"][0]["name"] == "query"
+
+
+# --- terminal records through the coordinator ------------------------------
+
+def test_coordinator_records_history_and_serves_endpoints(tmp_path):
+    co = Coordinator(history_dir=str(tmp_path)).start()
+    try:
+        c = StatementClient(co.base_uri,
+                            session_properties={"slow_query_log_ms": "1"})
+        res = c.execute("SELECT count(*) FROM tpch.tiny.nation")
+        _wait_until(lambda: co.history.get(res.query_id) is not None,
+                    what="history record")
+        out = _get_json(f"{co.base_uri}/v1/history")
+        rec = next(r for r in out["records"]
+                   if r["query_id"] == res.query_id)
+        assert rec["state"] == "FINISHED"
+        assert rec["plan_key"] and rec["sql_digest"]
+        assert rec["wall_s"] > 0 and rec["cpu_s"] > 0
+        assert rec["rows"] == 1
+        assert rec["operators"], "per-operator rows-in/out missing"
+        # ?state= and ?limit= filters
+        assert _get_json(f"{co.base_uri}/v1/history?state=FAILED"
+                         )["records"] == []
+        assert len(_get_json(f"{co.base_uri}/v1/history?limit=1"
+                             )["records"]) == 1
+        # learned stats observed the execution
+        stats = _get_json(f"{co.base_uri}/v1/stats")
+        mine = [e for e in stats["entries"]
+                if e["key"] == rec["plan_key"]]
+        assert mine and any(e["selectivity"] is not None for e in mine)
+        # bare /v1/trace (404'd before this PR) lists the trace
+        traces = _get_json(f"{co.base_uri}/v1/trace")["traces"]
+        assert any(t["queryId"] == res.query_id for t in traces)
+        # slow-query log armed at 1ms caught it
+        slow = (tmp_path / "slow_queries.jsonl").read_text()
+        assert res.query_id in slow
+    finally:
+        co.stop()
+
+
+def test_history_disabled_by_session_property(tmp_path):
+    co = Coordinator(history_dir=str(tmp_path)).start()
+    try:
+        c = StatementClient(co.base_uri, session_properties={
+            "query_history_enabled": "false"})
+        res = c.execute("SELECT 1")
+        time.sleep(0.3)
+        assert co.history.get(res.query_id) is None
+    finally:
+        co.stop()
+
+
+# --- failure-path records (satellite b) ------------------------------------
+
+def test_oom_kill_lands_classified_record(tmp_path):
+    """A CLUSTER_OUT_OF_MEMORY victim leaves one FAILED record with
+    the kill's error identity and non-zero queued/wall timing."""
+    from trino_tpu.server.memory import (ClusterMemoryManager,
+                                         ClusterMemoryPool)
+    store = QueryHistoryStore(str(tmp_path / "queries.jsonl"))
+    gates = {"big": threading.Event(), "small": threading.Event()}
+
+    class _Gated:
+        def __init__(self, session):
+            self.session = session
+
+        def execute(self, sql):
+            if self.session.memory is not None:
+                self.session.memory.reserve(
+                    700 if sql == "big" else 400)
+            gate = gates[sql]
+            while not gate.is_set():
+                if self.session.cancel is not None \
+                        and self.session.cancel.is_set():
+                    from trino_tpu.exec.executor import QueryError
+                    raise QueryError("Query was canceled")
+                gate.wait(0.01)
+            return QueryResult(["x"], [], [[1]])
+
+    tracker = QueryTracker(
+        _Gated, memory=ClusterMemoryManager(ClusterMemoryPool(1000)),
+        history_sink=lambda q: store.record(record_from_query(q)))
+    qbig = tracker.submit("big", Session())
+    _wait_until(lambda: qbig.state == "RUNNING", what="big running")
+    time.sleep(0.05)
+    qsmall = tracker.submit("small", Session())   # 700+400 > 1000
+    gates["small"].set()
+    _wait_until(lambda: store.get(qbig.query_id) is not None,
+                what="OOM record")
+    rec = store.get(qbig.query_id)
+    assert rec["state"] == "FAILED"
+    assert rec["error_name"] == "CLUSTER_OUT_OF_MEMORY"
+    assert rec["error_type"] == "INSUFFICIENT_RESOURCES"
+    assert rec["wall_s"] > 0
+    _wait_until(lambda: store.get(qsmall.query_id) is not None,
+                what="survivor record")
+    assert store.get(qsmall.query_id)["state"] == "FINISHED"
+    assert len(store) == 2
+
+
+def test_deadline_breach_lands_classified_record(tmp_path):
+    """EXCEEDED_TIME_LIMIT (query_max_run_time) through the real
+    coordinator: the record carries the deadline error identity and a
+    wall time at least the granted budget."""
+    from trino_tpu.catalog import CatalogManager
+    from trino_tpu.connectors.tpch import TpchConnector
+
+    class SlowTpch(TpchConnector):
+        def read_split(self, split, columns):
+            time.sleep(5)
+            return super().read_split(split, columns)
+
+    cats = CatalogManager()
+    cats.register("tpch", SlowTpch())
+    co = Coordinator(catalogs=cats, history_dir=str(tmp_path)).start()
+    try:
+        c = StatementClient(co.base_uri, session_properties={
+            "query_max_run_time": "1"})
+        with pytest.raises(ClientError, match="EXCEEDED_TIME_LIMIT"):
+            c.execute("SELECT count(*) FROM nation")
+        _wait_until(lambda: any(
+            r["error_name"] == "EXCEEDED_TIME_LIMIT"
+            for r in co.history.records()), what="deadline record")
+        rec = next(r for r in co.history.records()
+                   if r["error_name"] == "EXCEEDED_TIME_LIMIT")
+        assert rec["state"] == "FAILED"
+        assert rec["error_type"] == "INSUFFICIENT_RESOURCES"
+        assert rec["wall_s"] >= 0.9
+    finally:
+        co.stop()
+
+
+def test_queue_full_rejection_lands_classified_record(tmp_path):
+    """A QUEUE_FULL admission rejection is history too — the
+    rejection path never reaches run_and_release, so it exercises the
+    second recording site."""
+    from trino_tpu.server.resourcegroups import (ResourceGroup,
+                                                 ResourceGroupManager)
+    mgr = ResourceGroupManager()
+    g = mgr.root.add(ResourceGroup("tiny", hard_concurrency=1,
+                                   max_queued=0))
+    mgr.add_selector(g)
+    co = Coordinator(resource_groups=mgr,
+                     history_dir=str(tmp_path)).start()
+    try:
+        slow_sql = ("SELECT count(*) FROM tpch.tiny.lineitem a, "
+                    "tpch.tiny.lineitem b "
+                    "WHERE a.l_suppkey = b.l_suppkey")
+        errors = []
+
+        def occupy():
+            try:
+                StatementClient(co.base_uri).execute(slow_sql)
+            except Exception as e:      # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=occupy, daemon=True)
+        t.start()
+        _wait_until(lambda: any(q.state == "RUNNING"
+                                for q in co.tracker.all()),
+                    what="occupant running")
+        with pytest.raises(ClientError, match="QUERY_QUEUE_FULL"):
+            StatementClient(co.base_uri).execute("SELECT 1")
+        _wait_until(lambda: any(
+            r["error_name"] == "QUERY_QUEUE_FULL"
+            for r in co.history.records()), what="rejection record")
+        rec = next(r for r in co.history.records()
+                   if r["error_name"] == "QUERY_QUEUE_FULL")
+        assert rec["state"] == "FAILED"
+        assert rec["error_type"] == "INSUFFICIENT_RESOURCES"
+        assert rec["wall_s"] > 0          # rejected, but time passed
+        t.join(60)
+        assert not errors
+    finally:
+        co.stop()
+
+
+# --- restart survival + MPP acceptance -------------------------------------
+
+def test_history_and_learned_stats_survive_restart(tmp_path):
+    LEARNED_STATS.clear()
+    co1 = Coordinator(history_dir=str(tmp_path)).start()
+    try:
+        res = StatementClient(co1.base_uri).execute(
+            "SELECT count(*) FROM tpch.tiny.nation")
+        _wait_until(lambda: co1.history.get(res.query_id) is not None,
+                    what="history record")
+        assert len(LEARNED_STATS) > 0
+    finally:
+        co1.stop()                 # final learned-stats checkpoint
+    LEARNED_STATS.clear()          # simulate a fresh process
+    co2 = Coordinator(history_dir=str(tmp_path)).start()
+    try:
+        out = _get_json(f"{co2.base_uri}/v1/history")
+        rec = next(r for r in out["records"]
+                   if r["query_id"] == res.query_id)
+        assert rec["state"] == "FINISHED" and rec["plan_key"]
+        stats = _get_json(f"{co2.base_uri}/v1/stats")
+        assert stats["tracked"] > 0
+        assert any(e["key"] == rec["plan_key"]
+                   for e in stats["entries"])
+    finally:
+        co2.stop()
+
+
+def test_mpp_query_lands_in_system_runtime_tables(tmp_path):
+    """The acceptance e2e: a TPCH query through the DEFAULT MPP path
+    (real worker HTTP servers), then SELECT its own record back from
+    system.runtime.queries — matching id, canonical plan key, non-zero
+    cpu attribution — and its operators' learned selectivities from
+    system.runtime.operator_stats; finally a coordinator restart
+    serves both through /v1/history and /v1/stats."""
+    from trino_tpu.server.task_worker import TaskWorkerServer
+    LEARNED_STATS.clear()
+    workers = [TaskWorkerServer().start() for _ in range(2)]
+    co = Coordinator(worker_uris=[w.base_uri for w in workers],
+                     history_dir=str(tmp_path)).start()
+    try:
+        c = StatementClient(co.base_uri)
+        res = c.execute(
+            "SELECT n_name, count(*) c FROM nation "
+            "JOIN region ON n_regionkey = r_regionkey "
+            "GROUP BY n_name ORDER BY n_name")
+        assert len(res.rows) == 25
+        _wait_until(lambda: co.history.get(res.query_id) is not None,
+                    what="history record")
+        rows = c.execute(
+            "SELECT query_id, state, plan_key, cpu_s, rows "
+            "FROM system.runtime.queries "
+            f"WHERE query_id = '{res.query_id}'").rows
+        assert len(rows) == 1
+        qid, state, plan_key, cpu_s, nrows = rows[0]
+        assert qid == res.query_id and state == "FINISHED"
+        assert plan_key, "canonical plan key missing from record"
+        assert cpu_s > 0, "no cpu attribution through the MPP path"
+        assert nrows == 25
+        # worker-observed operator selectivities (shipped as
+        # learnedStats status deltas, merged at the scheduler)
+        ops = c.execute(
+            "SELECT plan_key, operator, selectivity, rows_per_s "
+            "FROM system.runtime.operator_stats "
+            "WHERE selectivity IS NOT NULL").rows
+        assert ops, "no learned operator stats after an MPP query"
+        assert all(sel >= 0 for _, _, sel, _ in ops)
+        # failed queries are selectable BY error classification
+        with pytest.raises(ClientError):
+            c.execute("SELECT no_such_column FROM nation")
+        _wait_until(lambda: any(
+            r.get("error_name") for r in co.history.records()),
+            what="failed record")
+        failed = c.execute(
+            "SELECT query_id, error_code FROM system.runtime.queries "
+            "WHERE error_code IS NOT NULL ORDER BY wall_s DESC").rows
+        assert failed and all(code for _, code in failed)
+        # the metrics ring/rollup table scans (cluster-wide: the
+        # coordinator's registry + scraped workers)
+        m = c.execute(
+            "SELECT count(*) FROM system.runtime.metrics "
+            "WHERE sample = 'current'").rows
+        assert m[0][0] > 0
+    finally:
+        co.stop()
+        for w in workers:
+            w.stop()
+    # restart: both surfaces survive the coordinator process
+    LEARNED_STATS.clear()
+    co2 = Coordinator(history_dir=str(tmp_path)).start()
+    try:
+        recs = _get_json(f"{co2.base_uri}/v1/history")["records"]
+        assert any(r["query_id"] == res.query_id for r in recs)
+        assert _get_json(f"{co2.base_uri}/v1/stats")["tracked"] > 0
+    finally:
+        co2.stop()

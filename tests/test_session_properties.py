@@ -26,7 +26,9 @@ def test_property_registry_breadth():
                  "multistage_execution", "exchange_partition_count",
                  "prewarm_enabled", "hot_shape_top_k",
                  "stream_chunk_rows", "result_cache_enabled",
-                 "ragged_batching", "ragged_batch_max_rows"):
+                 "ragged_batching", "ragged_batch_max_rows",
+                 "query_history_enabled", "learned_stats_enabled",
+                 "slow_query_log_ms"):
         assert name in SESSION_PROPERTIES, name
 
 
@@ -45,6 +47,23 @@ def test_point_lookup_serving_properties_defaults_and_types():
     assert s.get("ragged_batching") is True
     s.set("ragged_batch_max_rows", "4096")
     assert s.get("ragged_batch_max_rows") == 4096
+
+
+def test_observability_properties_defaults_and_types():
+    """ISSUE 19 knobs: history and learned stats default ON (the
+    always-on OperatorStats stance — the overhead tests hold them
+    under budget), the slow-query log defaults OFF (0 = disarmed,
+    any positive value is a millisecond threshold)."""
+    s = Session()
+    assert s.get("query_history_enabled") is True
+    assert s.get("learned_stats_enabled") is True
+    assert int(s.get("slow_query_log_ms")) == 0
+    s.set("query_history_enabled", "false")
+    assert s.get("query_history_enabled") is False
+    s.set("learned_stats_enabled", "false")
+    assert s.get("learned_stats_enabled") is False
+    s.set("slow_query_log_ms", "250")
+    assert s.get("slow_query_log_ms") == 250
 
 
 def test_stream_chunk_rows_defaults_and_types():

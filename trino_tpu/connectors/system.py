@@ -5,7 +5,18 @@ NodeSystemTable.java, KillQueryProcedure.java — 25+ files). The
 connector is constructed over a provider object (the Coordinator or a
 QueryTracker) exposing ``query_infos()`` / ``node_infos()`` /
 ``kill_query(id)``; in a plain LocalQueryRunner the provider is a stub
-with no queries."""
+with no queries.
+
+PR 19 grows the runtime schema into the engine's self-observation
+surface: ``queries`` serves the durable query-history records (terminal
+queries with error classification, timing attribution and the
+canonical plan key — live QUEUED/RUNNING queries ride along),
+``operator_stats`` serves the learned-stats registry's per-operator
+selectivity/throughput EMAs (exec/learnedstats.py), and ``metrics``
+serves the current metrics registry rolled up cluster-wide plus the
+periodic snapshot ring (obs/history.py MetricsRing) — so
+``SELECT * FROM system.runtime.queries WHERE error_code IS NOT NULL
+ORDER BY wall_s DESC`` works through the normal query path."""
 
 from __future__ import annotations
 
@@ -15,14 +26,30 @@ from typing import List, Optional, Sequence
 from ..catalog import (ColumnMetadata, Connector, Split, TableHandle,
                        TableMetadata)
 from ..columnar import Batch, batch_from_pylist
-from ..types import BIGINT, BOOLEAN, VARCHAR
+from ..types import BIGINT, BOOLEAN, DOUBLE, VARCHAR
 
 _RUNTIME_TABLES = {
     "queries": (
         ("query_id", VARCHAR), ("state", VARCHAR), ("user", VARCHAR),
         ("source", VARCHAR), ("query", VARCHAR),
-        ("queued_time_ms", BIGINT), ("analysis_time_ms", BIGINT),
-        ("created", VARCHAR),
+        ("sql_digest", VARCHAR), ("plan_key", VARCHAR),
+        ("error_code", VARCHAR), ("error_type", VARCHAR),
+        ("queued_s", DOUBLE), ("wall_s", DOUBLE), ("cpu_s", DOUBLE),
+        ("device_s", DOUBLE), ("rows", BIGINT),
+        ("peak_memory_bytes", BIGINT), ("spill_bytes", BIGINT),
+        ("stream_chunks", BIGINT), ("retries", BIGINT),
+        ("trace_id", VARCHAR), ("created", VARCHAR),
+    ),
+    "operator_stats": (
+        ("plan_key", VARCHAR), ("operator", VARCHAR),
+        ("occurrence", BIGINT), ("observations", BIGINT),
+        ("selectivity", DOUBLE), ("rows_per_s", DOUBLE),
+        ("rows_in", BIGINT), ("rows_out", BIGINT),
+        ("wall_s", DOUBLE), ("updated", VARCHAR),
+    ),
+    "metrics": (
+        ("captured_ms", BIGINT), ("node", VARCHAR), ("name", VARCHAR),
+        ("labels", VARCHAR), ("value", DOUBLE), ("sample", VARCHAR),
     ),
     "nodes": (
         ("node_id", VARCHAR), ("http_uri", VARCHAR),
@@ -36,6 +63,14 @@ _RUNTIME_TABLES = {
 }
 
 
+def _iso(epoch) -> str:
+    try:
+        return time.strftime("%Y-%m-%dT%H:%M:%S",
+                             time.localtime(float(epoch)))
+    except (TypeError, ValueError, OverflowError, OSError):
+        return ""
+
+
 class SystemProvider:
     """Provider SPI; the Coordinator implements these."""
 
@@ -46,6 +81,22 @@ class SystemProvider:
         return []
 
     def resource_group_infos(self) -> List[dict]:
+        return []
+
+    def history_infos(self) -> List[dict]:
+        """Query-history records (obs/history.py record schema) —
+        terminal queries first, live ones appended by the
+        coordinator's implementation."""
+        return []
+
+    def operator_stat_infos(self) -> List[dict]:
+        """Learned-stats registry snapshot
+        (exec/learnedstats.py LearnedStatsRegistry.snapshot)."""
+        return []
+
+    def metric_infos(self) -> List[dict]:
+        """Flattened metric samples: dicts with captured_ms, node,
+        name, labels, value, sample ("current" | "ring")."""
         return []
 
     def kill_query(self, query_id: str) -> bool:
@@ -78,11 +129,39 @@ class SystemConnector(Connector):
         cols = _RUNTIME_TABLES[table]
         if table == "queries":
             rows = [
-                (i.get("queryId", ""), i.get("state", ""),
-                 i.get("user", ""), i.get("source", ""),
-                 i.get("query", ""), i.get("elapsedTimeMillis", 0),
-                 i.get("analysisTimeMillis", 0), i.get("created", ""))
-                for i in self.provider.query_infos()]
+                (h.get("query_id", ""), h.get("state", ""),
+                 h.get("user", ""), h.get("source", ""),
+                 h.get("sql", h.get("query", "")),
+                 h.get("sql_digest", ""), h.get("plan_key", ""),
+                 h.get("error_name"), h.get("error_type"),
+                 float(h.get("queued_s") or 0.0),
+                 float(h.get("wall_s") or 0.0),
+                 float(h.get("cpu_s") or 0.0),
+                 float(h.get("device_s") or 0.0),
+                 int(h.get("rows") or 0),
+                 int(h.get("peak_memory_bytes") or 0),
+                 int(h.get("spill_bytes") or 0),
+                 int(h.get("stream_chunks") or 0),
+                 int(h.get("retries") or 0),
+                 h.get("trace_id"), _iso(h.get("created")))
+                for h in self.provider.history_infos()]
+        elif table == "operator_stats":
+            rows = [
+                (s.get("key", ""), s.get("op", ""),
+                 int(s.get("idx") or 0), int(s.get("n") or 0),
+                 s.get("selectivity"), s.get("rows_per_s"),
+                 int(s.get("rows_in") or 0),
+                 int(s.get("rows_out") or 0),
+                 float(s.get("wall_s") or 0.0),
+                 _iso(s.get("updated")))
+                for s in self.provider.operator_stat_infos()]
+        elif table == "metrics":
+            rows = [
+                (int(m.get("captured_ms") or 0), m.get("node", ""),
+                 m.get("name", ""), m.get("labels", ""),
+                 float(m.get("value") or 0.0),
+                 m.get("sample", "current"))
+                for m in self.provider.metric_infos()]
         elif table == "nodes":
             rows = [
                 (i.get("nodeId", ""), i.get("uri", ""),

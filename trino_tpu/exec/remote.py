@@ -910,6 +910,12 @@ class RemoteScheduler:
                     # (exec/hotshapes.py)
                     from .hotshapes import HOT_SHAPES
                     HOT_SHAPES.merge(status.get("hotShapes") or [])
+                    # same transport, same dedup: the worker's observed
+                    # per-operator rows/walls feed the coordinator's
+                    # learned-stats registry (exec/learnedstats.py)
+                    from .learnedstats import LEARNED_STATS
+                    LEARNED_STATS.merge(status.get("learnedStats")
+                                        or [])
                     reported = [NodeStats.from_dict(d) for d in
                                 status.get("nodeStats") or []]
                     if reported:
@@ -1429,6 +1435,12 @@ class DistributedHostQueryRunner:
         res.cpu_seconds = sched.cpu_seconds
         res.device_seconds = sched.device_seconds
         res.ragged_batched = sched.ragged_batched
+        res.speculative_wins = sched.speculative_wins
+        # canonical plan key for the history record / learned stats
+        # (exec/learnedstats.py): computed from the OPTIMIZED root
+        # plan, the same identity a local run of this query would get
+        from .learnedstats import plan_key_for
+        res.plan_key = plan_key_for(plan)
         if self.collect_node_stats:
             res.stats = sched.stats
         return res

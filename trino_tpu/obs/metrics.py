@@ -463,6 +463,30 @@ OTLP_EXPORTS = METRICS.counter(
     "OTLP trace-export attempts by sink and outcome (obs/otlp.py "
     "file/HTTP sinks)", ("sink", "result"))
 
+# query history + learned operator statistics (obs/history.py +
+# exec/learnedstats.py): terminal-query records appended to the
+# durable history store, slow-query-log emissions, and the learned
+# selectivity/throughput registry's observation flow. Registered here
+# — not in the producer modules — so coordinator scrapes, worker
+# scrapes and bench deltas all read one family identity.
+HISTORY_RECORDS = METRICS.counter(
+    "trino_tpu_query_history_records_total",
+    "Terminal-query records appended to the coordinator's durable "
+    "query-history store, by terminal state", ("state",))
+SLOW_QUERY_LOGS = METRICS.counter(
+    "trino_tpu_slow_query_log_total",
+    "Queries whose wall time crossed the slow_query_log_ms threshold "
+    "and were written to the trace-linked slow-query log")
+LEARNED_STATS_OBSERVATIONS = METRICS.counter(
+    "trino_tpu_learned_stats_observations_total",
+    "Per-operator executions folded into the learned-stats registry "
+    "(observed = this process's executors, merged = worker "
+    "task-status deltas)", ("outcome",))
+LEARNED_STATS_SIZE = METRICS.gauge(
+    "trino_tpu_learned_stats_entries",
+    "(program key, operator, occurrence) entries currently tracked "
+    "by the learned-stats registry")
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

@@ -50,6 +50,10 @@ class QueryResult:
     trace: Optional[object] = None          # obs.trace.QueryTrace
     peak_memory_bytes: int = 0
     spill_bytes: int = 0
+    # canonical plan key (exec/learnedstats.py plan_key_for): the
+    # identity the query-history store and the learned-stats registry
+    # share — renamed/reordered plans of one structural program match
+    plan_key: str = ""
 
     def __iter__(self):
         return iter(self.rows)
@@ -398,6 +402,19 @@ class LocalQueryRunner:
         result.ragged_batched = getattr(ex, "ragged_batched", 0)
         if collect_stats:
             result.stats = ex.stats
+            # learned operator statistics (exec/learnedstats.py): this
+            # LOCAL execution's observed rows-in/rows-out feed the
+            # selectivity/throughput EMAs under the plan's canonical
+            # key — dispatched fragments report theirs via worker
+            # task-status deltas instead, so nothing double-counts
+            from .exec.learnedstats import (plan_key_for,
+                                            record_node_stats)
+            result.plan_key = plan_key_for(plan)
+            try:
+                record_node_stats(result.plan_key, ex.stats,
+                                  self.session)
+            except Exception:   # noqa: BLE001 — telemetry best-effort
+                pass
         return result
 
     def _explain(self, stmt: A.Explain) -> QueryResult:

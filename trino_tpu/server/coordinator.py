@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import itertools
 import json
+import os
 import threading
 import time
 import traceback
@@ -200,7 +201,8 @@ class QueryTracker:
     lifecycle events (event/QueryMonitor.java:130,206)."""
 
     def __init__(self, make_runner, events=None, resource_groups=None,
-                 result_store=None, memory=None, manifest_store=None):
+                 result_store=None, memory=None, manifest_store=None,
+                 history_sink=None):
         from .events import EventListenerManager
         self._queries: Dict[str, _Query] = {}
         self._lock = threading.Lock()
@@ -231,6 +233,11 @@ class QueryTracker:
         # once the query is terminal (any state — a finished, failed or
         # canceled query must not be resumable by a later coordinator)
         self.manifests = manifest_store
+        # terminal-query observability (obs/history.py): called with
+        # the query after EVERY terminal transition — normal runs AND
+        # admission rejections — so the history store sees FINISHED,
+        # FAILED, CANCELED and QUEUE_FULL alike
+        self.history_sink = history_sink
 
     def submit(self, sql: str, session: Session,
                source: str = "") -> _Query:
@@ -442,6 +449,11 @@ class QueryTracker:
                     cumulative_operator_stats=cum,
                     operator_summaries=tuple(
                         s.to_dict() for s in stats)))
+                if self.history_sink is not None:
+                    try:
+                        self.history_sink(q)
+                    except Exception:    # noqa: BLE001 — history is
+                        pass             # best-effort bookkeeping
 
         def start(group=None):
             # the group is recorded BEFORE the thread exists so a
@@ -496,6 +508,13 @@ class QueryTracker:
                     q.query_id, q.sql, q.session.user, "FAILED",
                     0.0, error_name="QUERY_QUEUE_FULL",
                     error_message=str(e)))
+                if self.history_sink is not None:
+                    # rejections are history too: a queue-full storm
+                    # must be diagnosable from system.runtime.queries
+                    try:
+                        self.history_sink(q)
+                    except Exception:    # noqa: BLE001
+                        pass
 
     def get(self, qid: str) -> Optional[_Query]:
         with self._lock:
@@ -536,7 +555,8 @@ class Coordinator:
                  event_listeners=None, authenticator=None,
                  worker_uris=None, failure_detector=None,
                  spool=None, spool_backend: Optional[str] = None,
-                 memory_pool_bytes: Optional[int] = None):
+                 memory_pool_bytes: Optional[int] = None,
+                 history_dir: Optional[str] = None):
         from .events import EventListenerManager
         self.node_id = f"coordinator-{uuid.uuid4().hex[:8]}"
         self.started = time.time()
@@ -687,6 +707,25 @@ class Coordinator:
             from .memory import ClusterMemoryManager, ClusterMemoryPool
             self.memory = ClusterMemoryManager(
                 ClusterMemoryPool(int(pool_bytes)))
+        # query history & learned statistics (obs/history.py,
+        # exec/learnedstats.py): terminal queries append durable JSONL
+        # records under the spool/history dir; the learned-stats
+        # registry checkpoints there too so EMAs survive restarts.
+        # An explicit history_dir decouples tests (and co-located
+        # coordinators) from the process-wide spool default.
+        from ..exec.learnedstats import LEARNED_STATS
+        from ..obs.history import (MetricsRing, QueryHistoryStore,
+                                   TraceRing)
+        hist_dir = history_dir or os.path.join(_CONFIG.spool_dir,
+                                               "history")
+        self.history = QueryHistoryStore(
+            os.path.join(hist_dir, "queries.jsonl"))
+        self.trace_ring = TraceRing()
+        self.metrics_ring = MetricsRing()
+        self._learned_stats_path = os.path.join(hist_dir,
+                                                "learned_stats.json")
+        self._learned_saved_at = 0.0
+        LEARNED_STATS.load(self._learned_stats_path)
         # resume_query builds manifest-driven runners through the same
         # factory (live membership, failure detector, spool wiring)
         self._make_runner = make_runner
@@ -694,7 +733,8 @@ class Coordinator:
                                     resource_groups,
                                     result_store=self.results,
                                     memory=self.memory,
-                                    manifest_store=self.manifests)
+                                    manifest_store=self.manifests,
+                                    history_sink=self._on_query_terminal)
         self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
@@ -749,6 +789,13 @@ class Coordinator:
 
     def stop(self):
         METRICS.unregister_collector(self._metric_collector)
+        try:
+            # final learned-stats checkpoint: the throttled per-query
+            # saves may be up to one interval stale at shutdown
+            from ..exec.learnedstats import LEARNED_STATS
+            LEARNED_STATS.save(self._learned_stats_path)
+        except Exception:        # noqa: BLE001 — shutdown best-effort
+            pass
         if self.failure_detector is not None:
             self.failure_detector.stop()
         self._httpd.shutdown()
@@ -1111,6 +1158,90 @@ class Coordinator:
                      ((q.ended or time.time()) - q.created) * 1000)}
                 for q in self.tracker.all()]
 
+    # ---- query history & learned stats (obs/history.py) ---------------
+    def _on_query_terminal(self, q) -> None:
+        """Terminal-query bookkeeping, called from the tracker's run
+        thread (and the admission-rejection path): one history record,
+        the slow-query side log, the trace ring, a metrics-ring sample
+        and a throttled learned-stats checkpoint."""
+        from ..exec.learnedstats import LEARNED_STATS
+        from ..obs.history import record_from_query
+        sess = q.session
+        if bool(sess.get("query_history_enabled")):
+            rec = self.history.record(record_from_query(q))
+            threshold = int(sess.get("slow_query_log_ms") or 0)
+            if threshold > 0 and rec["wall_s"] * 1000.0 >= threshold:
+                self.history.slow_log(rec, threshold)
+        trace = getattr(q.result, "trace", None) \
+            if q.result is not None else None
+        self.trace_ring.append(q.query_id, q.state, trace)
+        self.metrics_ring.maybe_sample(self._collect_cluster_metrics)
+        now = time.time()
+        if now - self._learned_saved_at >= 5.0:
+            # checkpoint throttle: racing terminal threads may both
+            # save — harmless (atomic rename, same content modulo a
+            # few observations); stop() takes the final one
+            self._learned_saved_at = now  # tt-lint: ignore[race-attr-write] benign double-save
+            LEARNED_STATS.save(self._learned_stats_path)
+
+    def _collect_cluster_metrics(self) -> dict:
+        """{node: parsed exposition} — this coordinator's registry
+        plus a best-effort /metrics scrape of every live worker (the
+        cluster-wide rollup behind system.runtime.metrics)."""
+        from ..obs.metrics import parse_exposition
+        nodes = {self.node_id: parse_exposition(METRICS.render())}
+        import urllib.request
+        for w in self.live_workers():
+            try:
+                with urllib.request.urlopen(f"{w}/metrics",
+                                            timeout=2.0) as resp:
+                    nodes[w] = parse_exposition(
+                        resp.read().decode("utf-8", "replace"))
+            except Exception:    # noqa: BLE001 — scrape best-effort
+                continue
+        return nodes
+
+    def history_infos(self) -> list:
+        """system.runtime.queries rows: live QUEUED/RUNNING queries
+        first (record-shaped, built on the fly), then the durable
+        terminal history, newest first."""
+        from ..obs.history import record_from_query
+        recs = self.history.records()
+        seen = {r.get("query_id") for r in recs}
+        live = [record_from_query(q) for q in self.tracker.all()
+                if q.state in ("QUEUED", "RUNNING")
+                and q.query_id not in seen]
+        return live + recs
+
+    def operator_stat_infos(self) -> list:
+        from ..exec.learnedstats import LEARNED_STATS
+        return LEARNED_STATS.snapshot()
+
+    def metric_infos(self) -> list:
+        """system.runtime.metrics rows: the current cluster-wide
+        sample plus every ring snapshot, flattened."""
+        self.metrics_ring.maybe_sample(self._collect_cluster_metrics)
+        out = []
+
+        def flatten(ts_ms, nodes, sample):
+            for node, families in (nodes or {}).items():
+                for name, series in families.items():
+                    for labels, value in series.items():
+                        out.append({"captured_ms": ts_ms, "node": node,
+                                    "name": name,
+                                    "labels": ",".join(labels),
+                                    "value": value, "sample": sample})
+
+        try:
+            flatten(int(time.time() * 1000),
+                    self._collect_cluster_metrics(), "current")
+        except Exception:        # noqa: BLE001 — scan must not fail
+            pass
+        for snap in self.metrics_ring.snapshots():
+            flatten(int(float(snap.get("ts") or 0.0) * 1000),
+                    snap.get("nodes"), "ring")
+        return out
+
     # ---- SystemProvider SPI (connectors/system.py) --------------------
     def node_infos(self) -> list:
         nodes = [{"nodeId": self.node_id, "uri": self.base_uri,
@@ -1450,6 +1581,37 @@ def _make_handler(co: Coordinator):
                     k = int(SESSION_PROPERTIES["hot_shape_top_k"][1])
                 self._send(200, {"shapes": HOT_SHAPES.top(k),
                                  "tracked": len(HOT_SHAPES)})
+                return
+            if path == "/v1/history":
+                # the durable query-history surface (obs/history.py):
+                # ?limit= bounds the page, ?state= filters (FINISHED /
+                # FAILED / CANCELED)
+                from urllib.parse import parse_qs
+                qs = parse_qs(urlparse(self.path).query)
+                try:
+                    limit = int((qs.get("limit") or [0])[0]) or None
+                except ValueError:
+                    limit = None
+                self._send(200, {
+                    "records": co.history.records(
+                        limit=limit,
+                        state=(qs.get("state") or [None])[0]),
+                    "tracked": len(co.history)})
+                return
+            if path == "/v1/stats":
+                # learned operator statistics (exec/learnedstats.py):
+                # per (plan key, operator, occurrence) selectivity and
+                # throughput EMAs, most recently observed first
+                from ..exec.learnedstats import LEARNED_STATS
+                self._send(200, {
+                    "entries": LEARNED_STATS.snapshot(),
+                    "tracked": len(LEARNED_STATS)})
+                return
+            if path == "/v1/trace":
+                # bare listing (this 404'd before): recent trace ids +
+                # root-span summaries, each expandable at
+                # /v1/trace/{query_id}
+                self._send(200, {"traces": co.trace_ring.list()})
                 return
             if len(parts) == 3 and parts[:2] == ["v1", "trace"]:
                 # the finished query's distributed trace as OTLP/JSON

@@ -167,6 +167,11 @@ class _Task:
         # the coordinator's registry covers every DISPATCHED
         # fragment's shapes, not only its own combine programs
         self.hot_shapes: List[dict] = []
+        # learned-stats observation delta (exec/learnedstats.py):
+        # per-operator rows-in/rows-out/wall this task observed, keyed
+        # by the fragment's canonical plan key — the coordinator's
+        # registry merges these from the status beat (origin-deduped)
+        self.learned_stats: List[dict] = []
         self.peak_memory_bytes = 0
         self.spill_bytes = 0
         # morsel streaming (exec/streamjoin.py): chunk count + h2d
@@ -202,7 +207,9 @@ class _Task:
     def run(self, payload: dict):
         import time as _time
         from ..exec.hotshapes import HOT_SHAPES
+        from ..exec.learnedstats import LEARNED_STATS
         shapes_before = HOT_SHAPES.hit_counts()
+        lstats_before = LEARNED_STATS.seq()
         handle = None
         cpu0 = _time.thread_time()
         try:
@@ -330,6 +337,18 @@ class _Task:
                 else:
                     res = ex.execute(body)
                 self.node_stats = [s.to_dict() for s in ex.stats]  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+                if collect and ex.stats:
+                    # learned stats: observe this fragment's operator
+                    # flow under the fragment body's canonical key (the
+                    # peeled plan — the program the executor actually
+                    # ran); exported as a delta in the finally below
+                    from ..exec.learnedstats import (plan_key_for,
+                                                     record_node_stats)
+                    try:
+                        record_node_stats(plan_key_for(body), ex.stats,
+                                          session)
+                    except Exception:  # noqa: BLE001 — best-effort
+                        pass
                 self.peak_memory_bytes = ex.peak_reserved_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.spill_bytes = ex.spilled_bytes  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
                 self.stream_chunks = ex.stream_chunks  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
@@ -404,6 +423,14 @@ class _Task:
                 # never multiply cumulative counts per status the way a
                 # raw export would
                 self.hot_shapes = HOT_SHAPES.export_delta(shapes_before)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
+            except Exception:    # noqa: BLE001
+                pass
+            try:
+                # observation DELTAS since the task started, original
+                # origins preserved — the coordinator-side merge skips
+                # its own (shared-process workers) without losing a
+                # remote worker's genuine observations
+                self.learned_stats = LEARNED_STATS.export_delta(lstats_before)  # tt-lint: ignore[race-attr-write] task-thread-private until done.set() publishes
             except Exception:    # noqa: BLE001
                 pass
             _M_TASKS.inc(state=self.state)
@@ -650,6 +677,7 @@ class TaskWorkerServer:
                          "nodeStats": t.node_stats,
                          "spans": t.spans,
                          "hotShapes": t.hot_shapes,
+                         "learnedStats": t.learned_stats,
                          "peakMemoryBytes": t.peak_memory_bytes,
                          "liveMemoryBytes": t.live_memory_bytes,
                          "spillBytes": t.spill_bytes,

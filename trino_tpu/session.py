@@ -180,6 +180,25 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # still exists (EXPLAIN ANALYZE, /v1/query, /v1/trace) but nothing
     # leaves the process — the per-query opt-out for sensitive SQL.
     "otlp_export": (bool, True),
+    # ---- query history + learned statistics (obs/history.py +
+    # exec/learnedstats.py) --------------------------------------------
+    # append this query's terminal record to the coordinator's durable
+    # history store (GET /v1/history, system.runtime.queries). Off =
+    # the query runs unrecorded — the per-query opt-out for sensitive
+    # SQL (the record carries the statement text and digest).
+    "query_history_enabled": (bool, True),
+    # fold this query's observed per-operator rows-in/rows-out and
+    # wall time into the learned-stats registry (selectivity and
+    # rows/s EMAs keyed by canonical program key — GET /v1/stats,
+    # system.runtime.operator_stats, the adaptive cost model's seed).
+    # Off = the query still BENEFITS from learned priors but
+    # contributes nothing (e.g. deliberately skewed test corpora).
+    "learned_stats_enabled": (bool, True),
+    # slow-query log threshold in milliseconds: a terminal query whose
+    # wall time (queued included) crosses it is written — full record,
+    # trace id linked — to slow_queries.jsonl next to the history
+    # file. 0 disables the outlier log (the default).
+    "slow_query_log_ms": (int, 0),
 }
 
 

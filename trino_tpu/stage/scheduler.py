@@ -460,6 +460,12 @@ class StageExecution:
                 # worker-side joins/aggregations too (exec/hotshapes)
                 from ..exec.hotshapes import HOT_SHAPES
                 HOT_SHAPES.merge(status.get("hotShapes") or [])
+                # the worker's observed per-operator selectivities /
+                # rates ride the same status beat into the learned-
+                # stats registry (exec/learnedstats.py) — origin-
+                # deduped like the hot shapes above
+                from ..exec.learnedstats import LEARNED_STATS
+                LEARNED_STATS.merge(status.get("learnedStats") or [])
                 cpu_s = float(status.get("cpuSeconds") or 0.0)
                 dev_s = float(status.get("deviceSeconds") or 0.0)
                 with s._stats_lock:
