@@ -1051,6 +1051,104 @@ def _leg_storm(duration_s: float, clients: int) -> dict:
     })
 
 
+def _leg_streaming(duration_s: float) -> dict:
+    """Streaming ingest throughput leg (ISSUE 20): a producer streams
+    newline-delimited JSON batches into POST /v1/ingest/{topic} for a
+    fixed duration while a continuous ``insert`` job drains the topic
+    into a sink table on a poll cadence. Headline is
+    ``ingest_rows_per_sec`` (producer-observed append throughput
+    through the HTTP route, segment-file durability included);
+    ride-alongs are the drain side — rows the continuous job moved
+    per second, cycles it took, and the end-to-end lag from last
+    ingest to fully-drained sink."""
+    import json as _json
+    import tempfile
+    import urllib.request
+
+    import trino_tpu  # noqa: F401
+    from trino_tpu.client import StatementClient
+    from trino_tpu.config import CONFIG as _CFG
+    from trino_tpu.server.coordinator import Coordinator
+
+    _CFG.stream_dir = tempfile.mkdtemp(prefix="bench_stream_")
+    BATCH = 200                       # rows per producer POST
+
+    def _post(uri, body=b"", method="POST"):
+        req = urllib.request.Request(uri, data=body or None,
+                                     method=method)
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return _json.load(resp)
+
+    def _batch(base: int) -> bytes:
+        return b"\n".join(
+            _json.dumps({"k": (base + i) % 16, "v": float(base + i),
+                         "ts": float(base + i)}).encode()
+            for i in range(BATCH))
+
+    co = Coordinator().start()
+    try:
+        c = StatementClient(co.base_uri)
+        c.execute("CREATE TABLE stream.default.bench_events "
+                  "(k BIGINT, v DOUBLE, ts DOUBLE)")
+        c.execute("CREATE TABLE memory.default.bench_sink "
+                  "(k BIGINT, o BIGINT, v DOUBLE)")
+        # warm-up round = one ingest POST + the scan the continuous
+        # cycles will re-dispatch, split into the leg's compile/warm
+        # scoreboard keys
+        warm = [0]
+
+        def round_once():
+            _post(co.base_uri + "/v1/ingest/bench_events",
+                  _batch(warm[0]))
+            warm[0] += BATCH
+            c.execute("SELECT count(*) "
+                      "FROM stream.default.bench_events")
+
+        cold_s, warm_s = _cold_warm(round_once, 2)
+        job = _post(co.base_uri + "/v1/continuous", _json.dumps({
+            "kind": "insert", "topic": "bench_events",
+            "poll_interval_ms": 100,
+            "sql": "INSERT INTO memory.default.bench_sink "
+                   "SELECT k, _offset, v "
+                   "FROM stream.default.bench_events"}).encode())
+        # the ingest storm: closed-loop single producer for the
+        # duration — every POST durably appends before returning
+        produced = warm[0]
+        t0 = time.monotonic()
+        while time.monotonic() < t0 + duration_s:
+            _post(co.base_uri + "/v1/ingest/bench_events",
+                  _batch(produced))
+            produced += BATCH
+        ingest_s = time.monotonic() - t0
+        # drain: wait for the continuous job to catch up, then read
+        # its scoreboard
+        drain_t0 = time.monotonic()
+        deadline = drain_t0 + max(duration_s * 10, 30.0)
+        sink = 0
+        while time.monotonic() < deadline:
+            sink = c.execute("SELECT count(*) FROM "
+                             "memory.default.bench_sink").rows[0][0]
+            if sink >= produced:
+                break
+            time.sleep(0.1)
+        drain_lag_s = time.monotonic() - drain_t0
+        info = _post(co.base_uri + "/v1/continuous/" + job["job_id"],
+                     method="GET")
+        return dict(_cw_keys(cold_s, warm_s), **{
+            "ingest_rows_per_sec": (produced - warm[0]) / ingest_s,
+            "ingested_rows": produced,
+            "drained_rows": sink,
+            "drain_rows_per_sec": (
+                info["rows_total"] / max(ingest_s + drain_lag_s,
+                                         1e-9)),
+            "drain_lag_s": round(drain_lag_s, 3),
+            "continuous_cycles": info["cycles"],
+            "zero_dup_zero_loss": bool(sink == produced),
+        })
+    finally:
+        co.stop()
+
+
 def _run_probe_body(kind: str):
     """Inside the subprocess: run both legs, print one JSON line per leg
     the moment it completes so a timeout loses only the unfinished leg."""
@@ -1097,7 +1195,8 @@ def _run_probe_body(kind: str):
                 ("mpp", lambda: _leg_mpp(2)),
                 ("load", lambda: _leg_load(6.0, 6)),
                 ("load_mixed", lambda: _leg_load_mixed(6.0, 8)),
-                ("storm", lambda: _leg_storm(6.0, 64))]
+                ("storm", lambda: _leg_storm(6.0, 64)),
+                ("streaming", lambda: _leg_streaming(6.0))]
     for name, fn in legs:
         try:
             # every leg returns a dict carrying (at least) compile_s +
@@ -1196,6 +1295,17 @@ def _probe(kind: str, timeout: float, force_cpu: bool = False,
                 if k in d:
                     vals[f"storm_{k}" if not k.startswith("storm")
                          else k] = d[k]
+        elif leg == "streaming" and "ingest_rows_per_sec" in d:
+            # streaming ingest leg (ISSUE 20): the producer-side
+            # append throughput is the headline; the continuous
+            # job's drain side rides along
+            vals["streaming"] = d["ingest_rows_per_sec"]
+            for k in ("ingest_rows_per_sec", "drain_rows_per_sec",
+                      "drain_lag_s", "continuous_cycles",
+                      "ingested_rows", "drained_rows",
+                      "zero_dup_zero_loss"):
+                if k in d:
+                    vals[f"streaming_{k}"] = d[k]
         elif "qps" in d:
             # load leg ride-alongs: the concurrency scoreboard
             vals["load"] = d["qps"]

@@ -1,0 +1,123 @@
+"""First real coverage for formats/record_decoder.py (PR 20
+satellite): the decoders feed the streaming ingest path, where a
+malformed producer payload must decode to NULL-lane rows — never an
+error that could wedge a continuous query's cycle."""
+
+import pytest
+
+from trino_tpu.formats.record_decoder import (CsvRowDecoder,
+                                              DecoderField,
+                                              JsonRowDecoder,
+                                              RawRowDecoder,
+                                              create_decoder)
+from trino_tpu.types import BIGINT, BOOLEAN, DOUBLE, VARCHAR
+
+
+def _rows(batch):
+    return batch.to_pylist()
+
+
+# --- json ------------------------------------------------------------------
+
+def test_json_decodes_fields_and_paths():
+    dec = JsonRowDecoder([
+        DecoderField("k", BIGINT),
+        DecoderField("nested", DOUBLE, "a.b"),
+        DecoderField("first", VARCHAR, "tags/0"),
+    ])
+    rows = _rows(dec.decode([
+        b'{"k": 1, "a": {"b": 2.5}, "tags": ["x", "y"]}',
+        b'{"k": 2, "a": {}, "tags": []}',
+    ]))
+    assert rows == [[1, 2.5, "x"], [2, None, None]]
+
+
+def test_json_malformed_message_is_null_lane_row_not_error():
+    """The lenient-mode contract: undecodable messages land as
+    all-NULL rows so one bad producer payload cannot fail a scan."""
+    dec = JsonRowDecoder([DecoderField("k", BIGINT),
+                          DecoderField("v", VARCHAR)])
+    rows = _rows(dec.decode([
+        b'{"k": 1, "v": "ok"}',
+        b'{"k": truncated',          # malformed json
+        b"\xff\xfe not even text",   # invalid utf-8
+        b"",                         # empty message
+        b'{"k": 2, "v": "also ok"}',
+    ]))
+    assert rows[0] == [1, "ok"]
+    assert rows[1] == [None, None]
+    assert rows[2] == [None, None]
+    assert rows[3] == [None, None]
+    assert rows[4] == [2, "also ok"]
+
+
+def test_json_type_coercion_failures_are_null_not_error():
+    dec = JsonRowDecoder([DecoderField("n", BIGINT),
+                          DecoderField("b", BOOLEAN),
+                          DecoderField("s", VARCHAR)])
+    rows = _rows(dec.decode([
+        b'{"n": "not-a-number", "b": "true", "s": {"obj": 1}}',
+    ]))
+    # unparseable bigint -> NULL; "true" -> True; non-string value is
+    # re-serialized into the varchar lane rather than dropped
+    assert rows == [[None, True, '{"obj": 1}']]
+
+
+# --- csv -------------------------------------------------------------------
+
+def test_csv_decodes_by_index_mapping():
+    dec = CsvRowDecoder([DecoderField("name", VARCHAR, "0"),
+                         DecoderField("qty", BIGINT, "1")])
+    rows = _rows(dec.decode([b"widget,3", b'"a,b",7']))
+    assert rows == [["widget", 3], ["a,b", 7]]
+
+
+def test_csv_requires_numeric_mapping():
+    """A silent default index would decode column 0 into every
+    misconfigured field — construction must refuse instead."""
+    with pytest.raises(ValueError, match="numeric mapping"):
+        CsvRowDecoder([DecoderField("name", VARCHAR)])
+    with pytest.raises(ValueError, match="numeric mapping"):
+        CsvRowDecoder([DecoderField("name", VARCHAR, "zero")])
+
+
+def test_csv_nul_invalid_utf8_and_short_rows_are_null_lanes():
+    dec = CsvRowDecoder([DecoderField("a", VARCHAR, "0"),
+                         DecoderField("n", BIGINT, "1")])
+    rows = _rows(dec.decode([
+        b"ok,1",
+        b"x\x00y,2",          # embedded NUL (csv module rejects)
+        b"\xff\xfe,3",        # invalid utf-8 (replacement chars)
+        b"only-one-field",    # short row: missing index -> NULL
+        b"",                  # empty message -> no fields at all
+    ]))
+    assert rows[0] == ["ok", 1]
+    # NUL and replacement-decoded rows must not raise; every lane that
+    # could not be extracted is NULL, extracted lanes keep their value
+    assert rows[1][1] in (2, None)
+    assert rows[2][1] in (3, None)
+    assert rows[3] == ["only-one-field", None]
+    assert rows[4] == [None, None]
+
+
+# --- raw + factory ---------------------------------------------------------
+
+def test_raw_whole_message_single_field():
+    dec = RawRowDecoder([DecoderField("_message", VARCHAR)])
+    rows = _rows(dec.decode([b"hello", b"\xffworld"]))
+    assert rows[0] == ["hello"]
+    assert "world" in rows[1][0]    # invalid byte replaced, not fatal
+
+
+def test_create_decoder_dispatch_and_unknown_kind():
+    assert isinstance(
+        create_decoder("json", [DecoderField("k", BIGINT)]),
+        JsonRowDecoder)
+    assert isinstance(
+        create_decoder("csv", [DecoderField("k", BIGINT, "0")]),
+        CsvRowDecoder)
+    assert isinstance(
+        create_decoder("raw", [DecoderField("m", VARCHAR)]),
+        RawRowDecoder)
+    with pytest.raises(ValueError, match="unknown decoder"):
+        create_decoder("avro", [DecoderField("k", BIGINT)])

@@ -309,6 +309,9 @@ def test_distributed_stream_rollup():
             w.stop()
 
 
+@pytest.mark.slow      # ~102s: the single heaviest tier-1 test; the
+# chunked==unchunked matrix + streamed-peak governance tests keep the
+# fast lane covered
 def test_q18_sf1_streams_under_small_budget_matches_oracle():
     """Acceptance: the full q18 pipeline at sf1 completes under a
     memory budget smaller than its probe working set (the lineitem

@@ -297,7 +297,15 @@ _CROSS_CALLEES = ("fte/", "stage/", "obs/metrics.py", "obs/trace.py",
                   # beats merge and HTTP handler / system-table scan
                   # threads read, so their lock discipline must stay
                   # lint-reachable
-                  "obs/history.py", "exec/learnedstats.py")
+                  "obs/history.py", "exec/learnedstats.py",
+                  # PR 20: the streaming subsystem — ingest HTTP
+                  # threads append to partition segments while
+                  # continuous-job scheduler threads read windows and
+                  # commit offsets, and the stream connector's scans
+                  # run on worker task threads; every shared index
+                  # (partition positions, topic cache, job registry)
+                  # must stay visible to the race detector
+                  "streaming/", "connectors/stream.py")
 
 
 class _CrossIndex:

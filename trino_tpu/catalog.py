@@ -23,6 +23,10 @@ class ColumnMetadata:
     """spi/connector/ColumnMetadata.java"""
     name: str
     type: Type
+    # connector-provided columns (ColumnMetadata.isHidden analog —
+    # e.g. the stream connector's _partition/_offset ledger): still
+    # selectable by name, but never an INSERT target
+    hidden: bool = False
 
 
 @dataclass(frozen=True)

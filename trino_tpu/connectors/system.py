@@ -51,6 +51,14 @@ _RUNTIME_TABLES = {
         ("captured_ms", BIGINT), ("node", VARCHAR), ("name", VARCHAR),
         ("labels", VARCHAR), ("value", DOUBLE), ("sample", VARCHAR),
     ),
+    "continuous_queries": (
+        ("job_id", VARCHAR), ("kind", VARCHAR), ("state", VARCHAR),
+        ("sql", VARCHAR), ("target", VARCHAR), ("topic", VARCHAR),
+        ("poll_ms", BIGINT), ("cycles", BIGINT),
+        ("rows_total", BIGINT), ("last_epoch", BIGINT),
+        ("watermark", DOUBLE), ("last_error", VARCHAR),
+        ("created", VARCHAR),
+    ),
     "nodes": (
         ("node_id", VARCHAR), ("http_uri", VARCHAR),
         ("node_version", VARCHAR), ("coordinator", BOOLEAN),
@@ -92,6 +100,11 @@ class SystemProvider:
     def operator_stat_infos(self) -> List[dict]:
         """Learned-stats registry snapshot
         (exec/learnedstats.py LearnedStatsRegistry.snapshot)."""
+        return []
+
+    def continuous_query_infos(self) -> List[dict]:
+        """Continuous-query job snapshots
+        (streaming/continuous.py ContinuousJob.to_dict)."""
         return []
 
     def metric_infos(self) -> List[dict]:
@@ -162,6 +175,17 @@ class SystemConnector(Connector):
                  float(m.get("value") or 0.0),
                  m.get("sample", "current"))
                 for m in self.provider.metric_infos()]
+        elif table == "continuous_queries":
+            rows = [
+                (j.get("job_id", ""), j.get("kind", ""),
+                 j.get("state", ""), j.get("sql", ""),
+                 j.get("target"), j.get("topic"),
+                 int(j.get("poll_interval_ms") or 0),
+                 int(j.get("cycles") or 0),
+                 int(j.get("rows_total") or 0),
+                 int(j.get("last_epoch") or 0), j.get("watermark"),
+                 j.get("last_error"), _iso(j.get("created")))
+                for j in self.provider.continuous_query_infos()]
         elif table == "nodes":
             rows = [
                 (i.get("nodeId", ""), i.get("uri", ""),

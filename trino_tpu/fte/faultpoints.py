@@ -3,9 +3,13 @@
 Named crash sites are sprinkled through the dispatch path
 (``coordinator.pre_dispatch``, ``coordinator.post_stage_commit``,
 ``coordinator.mid_combine``), the worker status loop
-(``worker.pre_status_beat``) and the spool commit protocol
-(``spool.pre_marker``).  Each site is a single call to
-:func:`fault_point`, which is free when no schedule is armed.
+(``worker.pre_status_beat``), the spool commit protocol
+(``spool.pre_marker``) and the streaming ingest path
+(``stream.pre_append`` — a producer dying before its frame lands;
+``stream.pre_offset_commit`` — a consumer dying between a successful
+incremental INSERT and sealing its offset epoch, the at-least-once
+boundary).  Each site is a single call to :func:`fault_point`, which
+is free when no schedule is armed.
 
 A schedule maps a site name to an action:
 

@@ -487,6 +487,35 @@ LEARNED_STATS_SIZE = METRICS.gauge(
     "(program key, operator, occurrence) entries currently tracked "
     "by the learned-stats registry")
 
+# streaming ingestion + continuous queries (trino_tpu/streaming/ +
+# connectors/stream.py): producers POST /v1/ingest/{topic} on the
+# coordinator or any worker, offset commits seal each continuous
+# cycle, and the job scheduler re-dispatches incremental plans on a
+# cadence. Registered here — the producers span the message log, both
+# HTTP server modules and the continuous-query manager — so scrapes
+# and bench deltas read one family identity regardless of import
+# order.
+INGEST_ROWS = METRICS.counter(
+    "trino_tpu_ingest_rows_total",
+    "Messages appended to the streaming message log, by topic",
+    ("topic",))
+INGEST_BYTES = METRICS.counter(
+    "trino_tpu_ingest_bytes_total",
+    "Message payload bytes appended to the streaming message log, "
+    "by topic", ("topic",))
+OFFSET_COMMITS = METRICS.counter(
+    "trino_tpu_stream_offset_commits_total",
+    "Consumer offset epochs committed to the spool-backed offset "
+    "store, by outcome (committed = this process sealed the epoch, "
+    "superseded = an earlier commit already won)", ("outcome",))
+CONTINUOUS_CYCLES = METRICS.counter(
+    "trino_tpu_continuous_cycles_total",
+    "Continuous-query scheduler cycles, by outcome (advanced = new "
+    "offsets committed, idle = no new messages, failed)", ("outcome",))
+CONTINUOUS_JOBS = METRICS.gauge(
+    "trino_tpu_continuous_queries",
+    "Continuous-query jobs currently RUNNING on this coordinator")
+
 
 def write_exposition(handler) -> None:
     """Serve METRICS as a Prometheus text response on a

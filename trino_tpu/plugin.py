@@ -109,6 +109,11 @@ def _ensure_builtins() -> None:
     register_factory(ConnectorFactory(
         "system", lambda n, p: SystemConnector()))
 
+    def _stream(n, p):
+        from .connectors.stream import StreamConnector
+        return StreamConnector(p.get("stream.dir"))
+    register_factory(ConnectorFactory("stream", _stream))
+
     def _localfile(n, p):
         from .connectors.localfile import LocalFileConnector
         return LocalFileConnector(p.get("localfile.root", "."))

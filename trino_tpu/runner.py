@@ -87,6 +87,10 @@ class LocalQueryRunner:
             self.catalogs.register("blackhole", BlackholeConnector())
             from .connectors.system import SystemConnector
             self.catalogs.register("system", SystemConnector())
+            # disk-backed (CONFIG.stream_dir), so unlike memory this
+            # default genuinely shares state with worker processes
+            from .connectors.stream import StreamConnector
+            self.catalogs.register("stream", StreamConnector())
         self.session = session or Session(catalog="tpch", schema="tiny")
         self.mesh = mesh
         # engine transaction state (reference:
@@ -637,7 +641,8 @@ class LocalQueryRunner:
                 f"Table '{cat}.{schema}.{table}' does not exist")
         res = self._run_query(A.QueryStatement(stmt.query))
         target_cols = (list(stmt.columns) if stmt.columns
-                       else meta.column_names)
+                       else [c.name for c in meta.columns
+                             if not c.hidden])
         if len(res.columns) != len(target_cols):
             raise QueryError(
                 f"INSERT has {len(res.columns)} columns but table "

@@ -735,6 +735,28 @@ class Coordinator:
                                     memory=self.memory,
                                     manifest_store=self.manifests,
                                     history_sink=self._on_query_terminal)
+        # streaming ingestion + continuous queries (trino_tpu/
+        # streaming/): the process-wide message log backs POST
+        # /v1/ingest/{topic} and the stream catalog's scans; the
+        # continuous-query manager drives long-lived jobs whose cycles
+        # are REAL tracked queries (source "continuous"). Consumer
+        # offsets spool under reserved fragment -3 on the server spool
+        # (or the process default for a workerless coordinator), and
+        # the job ledger lives next to the query history so a
+        # replacement coordinator restarts RUNNING jobs (start()).
+        from ..streaming.continuous import ContinuousQueryManager
+        from ..streaming.log import get_log
+        from ..streaming.offsets import OffsetStore
+        self.stream_log = get_log()
+        off_spool = self.spool
+        if off_spool is None:
+            from ..fte.spool import default_spool
+            off_spool = default_spool()
+        self.continuous = ContinuousQueryManager(
+            self._run_continuous_sql, self._catalogs,
+            OffsetStore(off_spool),
+            jobs_path=os.path.join(hist_dir, "continuous.jsonl"),
+            log=self.stream_log)
         self._register_metric_collectors()
         self._httpd = ThreadingHTTPServer(("127.0.0.1", port),
                                           _make_handler(self))
@@ -785,9 +807,14 @@ class Coordinator:
         self._thread = threading.Thread(  # tt-lint: ignore[race-attr-write] lifecycle: start() runs once on the owning thread before the server is shared
             target=self._httpd.serve_forever, daemon=True)
         self._thread.start()
+        # coordinator-failover restart of continuous jobs: replay the
+        # durable ledger; restarted consumers resume from their
+        # committed offset epochs
+        self.continuous.restart_jobs()
         return self
 
     def stop(self):
+        self.continuous.stop()
         METRICS.unregister_collector(self._metric_collector)
         try:
             # final learned-stats checkpoint: the throttled per-query
@@ -1266,6 +1293,26 @@ class Coordinator:
     def kill_query(self, query_id: str) -> bool:
         return self.tracker.cancel(query_id)
 
+    def continuous_query_infos(self) -> list:
+        """system.runtime.continuous_queries rows."""
+        return self.continuous.infos()
+
+    # ---- continuous-query cycle driver --------------------------------
+    def _run_continuous_sql(self, sql: str):
+        """One continuous-query cycle = one REAL tracked query: it
+        rides admission, the stage DAG, FTE retries, history and the
+        system.runtime.queries surface like any client submission."""
+        session = Session(catalog="stream", schema="default",
+                          user="continuous")
+        q = self.tracker.submit(sql, session, source="continuous")
+        if not q.wait_done(600.0):
+            self.tracker.cancel(q.query_id)
+            raise TimeoutError(f"continuous cycle timed out: {sql!r}")
+        if q.state != "FINISHED":
+            msg = (q.error or {}).get("message", f"query {q.state}")
+            raise RuntimeError(msg)
+        return q.result
+
     def leak_report(self, stuck_after_s: float = 3600.0,
                     orphan_grace_s: float = 5.0):
         """Leak/orphan snapshot (execution/QueryTracker
@@ -1515,6 +1562,32 @@ def _make_handler(co: Coordinator):
                 self._send(200, {"joined": joined,
                                  "workers": co.live_workers()})
                 return
+            # /v1/ingest/{topic}: newline-delimited messages into the
+            # append-only log (producers hit the coordinator or ANY
+            # worker — the segment files are the shared truth)
+            parts = [p for p in path.split("/") if p]
+            if len(parts) == 3 and parts[:2] == ["v1", "ingest"]:
+                from ..streaming.log import ingest_http
+                from urllib.parse import parse_qs
+                n = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(n)
+                try:
+                    self._send(200, ingest_http(
+                        co.stream_log, parts[2], body,
+                        parse_qs(urlparse(self.path).query)))
+                except ValueError as e:
+                    self._send(400, {"error": str(e)})
+                return
+            if path == "/v1/continuous":
+                n = int(self.headers.get("Content-Length", 0))
+                try:
+                    spec = json.loads(self.rfile.read(n) or b"{}")
+                    job = co.continuous.create(spec)
+                except (ValueError, KeyError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                self._send(200, job)
+                return
             self._send(404, {"error": "not found"})
 
         def do_GET(self):
@@ -1607,6 +1680,16 @@ def _make_handler(co: Coordinator):
                     "entries": LEARNED_STATS.snapshot(),
                     "tracked": len(LEARNED_STATS)})
                 return
+            if path == "/v1/continuous":
+                self._send(200, {"jobs": co.continuous_query_infos()})
+                return
+            if len(parts) == 3 and parts[:2] == ["v1", "continuous"]:
+                job = co.continuous.get(parts[2])
+                if job is None:
+                    self._send(404, {"error": "no such job"})
+                    return
+                self._send(200, job)
+                return
             if path == "/v1/trace":
                 # bare listing (this 404'd before): recent trace ids +
                 # root-span summaries, each expandable at
@@ -1681,6 +1764,12 @@ def _make_handler(co: Coordinator):
                 left = co.remove_worker(uri) if uri else False
                 self._send(200, {"left": left,
                                  "workers": co.live_workers()})
+                return
+            if len(parts) == 3 and parts[:2] == ["v1", "continuous"]:
+                if co.continuous.cancel(parts[2]):
+                    self._send(200, {"canceled": parts[2]})
+                else:
+                    self._send(404, {"error": "no such job"})
                 return
             if len(parts) >= 4 and parts[:2] == ["v1", "statement"]:
                 co.tracker.cancel(parts[3])

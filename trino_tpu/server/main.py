@@ -57,7 +57,8 @@ def build_catalogs(etc_dir: Optional[str],
                       file=sys.stderr)
             made = True
     if not made:
-        for kind in ("tpch", "tpcds", "memory", "blackhole"):
+        for kind in ("tpch", "tpcds", "memory", "blackhole",
+                     "stream"):
             mgr.register(kind, plugin.create_connector(kind, kind))
     return mgr
 

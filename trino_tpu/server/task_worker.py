@@ -528,6 +528,31 @@ class TaskWorkerServer:
                     self.end_headers()
                     self.wfile.write(body)
                     return
+                # /v1/ingest/{topic}: any worker accepts producer
+                # appends — segment files under the shared stream dir
+                # are the source of truth, so the coordinator's scans
+                # see worker-side ingests with no forwarding hop
+                from urllib.parse import parse_qs, urlparse
+                parsed = urlparse(self.path)
+                route = [p for p in parsed.path.split("/") if p]
+                if len(route) == 3 and route[:2] == ["v1", "ingest"]:
+                    from ..streaming.log import get_log, ingest_http
+                    topic = route[2]
+                    n = int(self.headers.get("Content-Length", 0))
+                    data = self.rfile.read(n)
+                    try:
+                        out = ingest_http(get_log(), topic, data,
+                                          parse_qs(parsed.query))
+                        code = 200
+                    except ValueError as e:
+                        out, code = {"error": str(e)}, 400
+                    body = json.dumps(out).encode()
+                    self.send_response(code)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
                 self.send_error(404)
 
             def do_GET(self):

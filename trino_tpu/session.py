@@ -199,6 +199,17 @@ SESSION_PROPERTIES: Dict[str, Tuple[type, object]] = {
     # trace id linked — to slow_queries.jsonl next to the history
     # file. 0 disables the outlier log (the default).
     "slow_query_log_ms": (int, 0),
+    # ---- streaming ingestion + continuous queries (streaming/) -------
+    # default re-dispatch cadence for continuous-query jobs created
+    # without an explicit poll_interval_ms (streaming/continuous.py;
+    # the per-job spec value always wins). Milliseconds between the
+    # end of one incremental cycle and the start of the next.
+    "stream_poll_interval_ms": (int, CONFIG.stream_poll_interval_ms),
+    # default allowed event-time lateness for window jobs created
+    # without an explicit lateness_ms: the watermark trails
+    # max(event time) by this much, so late rows within the horizon
+    # still re-aggregate on the next cycle
+    "stream_lateness_ms": (int, CONFIG.stream_lateness_ms),
 }
 
 
